@@ -1,0 +1,6 @@
+"""librabft_simulator_tpu: a TPU-native batched discrete-event simulator for
+BFT consensus protocols (LibraBFTv2 + pluggable commit rules), with the
+capabilities of novifinancial/librabft_simulator re-designed for JAX/XLA.
+"""
+
+__version__ = "0.2.0"

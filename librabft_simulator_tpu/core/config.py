@@ -1,0 +1,48 @@
+"""EpochConfiguration as weight tensors
+(/root/reference/bft-lib/src/configuration.rs:18-76).
+
+Voting rights are an int32 vector ``weights[N]`` (index = author).  Author
+picking is cumsum + searchsorted instead of the reference's linear scan, so it
+vectorizes across instances and stays O(log N) per lookup on device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..utils import hashing as H
+
+
+def total_votes(weights):
+    return jnp.sum(weights, axis=-1)
+
+
+def quorum_threshold(weights):
+    """2N/3 + 1 (configuration.rs:52-56)."""
+    return 2 * total_votes(weights) // 3 + 1
+
+
+def validity_threshold(weights):
+    """(N + 2) / 3 (configuration.rs:58-62)."""
+    return (total_votes(weights) + 2) // 3
+
+
+def count_votes(weights, author_mask):
+    """Sum of voting rights over a boolean author mask (configuration.rs:43)."""
+    return jnp.sum(jnp.where(author_mask, weights, 0), axis=-1)
+
+
+def pick_author(weights, seed_u32):
+    """Weighted author choice: first author with cumweight > target
+    (configuration.rs:65-75).  ``seed_u32`` is a uint32 uniform draw."""
+    total = total_votes(weights).astype(jnp.uint32)
+    target = (seed_u32.astype(jnp.uint32) % total).astype(jnp.int32)
+    cum = jnp.cumsum(weights, axis=-1)
+    return jnp.searchsorted(cum, target, side="right").astype(jnp.int32)
+
+
+def leader_of_round(weights, round_):
+    """PacemakerState::leader (/root/reference/librabft-v2/src/pacemaker.rs:100):
+    hash the round, pick an author weighted by voting rights."""
+    u = H.fold(H.TAG_LEADER, jnp.asarray(round_).astype(jnp.uint32))
+    return pick_author(weights, u)

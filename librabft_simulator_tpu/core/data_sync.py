@@ -1,0 +1,250 @@
+"""DataSync: catch-up protocol over fixed-shape payloads.
+
+Tensor re-expression of ``impl DataSyncNode for NodeState``
+(/root/reference/librabft-v2/src/data_sync.rs:62-241).
+
+TPU-first redesign of responses: the reference ships *unbounded* record chains
+(``unknown_records``, record_store.rs:801-831).  Here a response carries a
+K-round tail of (block, QC) pairs ending at the responder's highest QC, plus
+the highest commit certificate with its block, timeouts and the proposal.  A
+receiver lagging beyond the window performs a production-style *state-sync
+jump*: it re-anchors a fresh store at the base of the received chain and
+adopts the committed state (counted in ``Context.sync_jumps``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import store as store_ops
+from .types import (
+    BlockMsg,
+    Context,
+    NodeExtra,
+    Payload,
+    QcMsg,
+    SimParams,
+    Store,
+    VoteMsg,
+)
+from ..utils import hashing as H
+
+I32 = jnp.int32
+
+
+def _i32(x):
+    return jnp.asarray(x, I32)
+
+
+def _slot(p, r):
+    return jnp.remainder(_i32(r), p.window)
+
+
+def qc_msg_at(p: SimParams, s: Store, r, var, valid):
+    sl = _slot(p, r)
+    blk_var = s.qc_blk_var[sl, var]
+    return QcMsg(
+        valid=jnp.asarray(valid, jnp.bool_),
+        epoch=s.epoch_id,
+        round=s.qc_round[sl, var],
+        blk_tag=s.blk_tag[sl, blk_var],
+        state_depth=s.qc_state_depth[sl, var],
+        state_tag=s.qc_state_tag[sl, var],
+        commit_valid=s.qc_commit_valid[sl, var],
+        commit_depth=s.qc_commit_depth[sl, var],
+        commit_tag=s.qc_commit_tag[sl, var],
+        author=s.qc_author[sl, var],
+        tag=s.qc_tag[sl, var],
+    )
+
+
+def blk_msg_at(p: SimParams, s: Store, r, var, valid):
+    sl = _slot(p, r)
+    return BlockMsg(
+        valid=jnp.asarray(valid, jnp.bool_),
+        round=s.blk_round[sl, var],
+        author=s.blk_author[sl, var],
+        prev_round=s.blk_prev_round[sl, var],
+        prev_tag=s.blk_prev_tag[sl, var],
+        time=s.blk_time[sl, var],
+        cmd_proposer=s.blk_cmd_proposer[sl, var],
+        cmd_index=s.blk_cmd_index[sl, var],
+        tag=s.blk_tag[sl, var],
+    )
+
+
+def own_vote_msg(p: SimParams, s: Store, author):
+    """current_vote (record_store.rs:762-764) as a wire vote."""
+    a = jnp.clip(_i32(author), 0, p.n_nodes - 1)
+    valid = s.vt_valid[a]
+    bvar = s.vt_blk_var[a]
+    sl = _slot(p, s.current_round)
+    return VoteMsg(
+        valid=valid, epoch=s.epoch_id, round=s.current_round,
+        blk_tag=s.blk_tag[sl, bvar],
+        state_depth=s.vt_state_depth[a], state_tag=s.vt_state_tag[a],
+        commit_valid=s.vt_commit_valid[a], commit_depth=s.vt_commit_depth[a],
+        commit_tag=s.vt_commit_tag[a], author=a,
+    )
+
+
+def create_notification(p: SimParams, s: Store, author) -> Payload:
+    """data_sync.rs:82-111.  (Past-epoch commit certificates are not kept in
+    the windowed design; cross-epoch laggards catch up via state-sync jumps.)"""
+    pay = Payload.empty(p.n_nodes, p.chain_k)
+    hcc = qc_msg_at(p, s, s.hcc_round, s.hcc_var, s.hcc_valid)
+    hqc = qc_msg_at(p, s, s.hqc_round, s.hqc_var, s.hqc_round > 0)
+    sl = _slot(p, s.current_round)
+    prop_var = jnp.maximum(s.proposed_var, 0)
+    # Do not reshare other leaders' proposals (data_sync.rs:99-109).
+    prop_valid = (s.proposed_var >= 0) & (s.blk_author[sl, prop_var] == author)
+    prop = blk_msg_at(p, s, s.current_round, prop_var, prop_valid)
+    return pay.replace(
+        epoch=s.epoch_id,
+        hcc=hcc,
+        hqc=hqc,
+        prop_blk=prop,
+        vote=own_vote_msg(p, s, author),
+        tc_to=pay.tc_to.replace(round=s.htc_round, valid=s.tc_valid, hcbr=s.tc_hcbr),
+        cur_to=pay.cur_to.replace(round=s.current_round, valid=s.to_valid, hcbr=s.to_hcbr),
+    )
+
+
+def create_request(p: SimParams, s: Store) -> Payload:
+    """data_sync.rs:66-72, 179-181: our epoch + where our chain stands (the
+    power2-minus-1 known-QC set degenerates to (hqc_round, hcr) under the
+    K-tail response design)."""
+    pay = Payload.empty(p.n_nodes, p.chain_k)
+    return pay.replace(epoch=s.epoch_id, req_hqc_round=s.hqc_round, req_hcr=s.hcr)
+
+
+def _insert_timeout_batch(p, s, weights, to_msg, rec_epoch):
+    """Insert a TimeoutsMsg author-by-author (lax.scan keeps the graph small
+    for N=64 configs)."""
+
+    def body(carry, a):
+        st = carry
+        st2, _ = store_ops.insert_timeout(
+            p, st, weights, rec_epoch, to_msg.round, to_msg.hcbr[a], a
+        )
+        return store_ops._sel(to_msg.valid[a], st2, st), None
+
+    s, _ = jax.lax.scan(body, s, jnp.arange(p.n_nodes))
+    return s
+
+
+def handle_notification(p: SimParams, s: Store, weights, pay: Payload):
+    """data_sync.rs:113-177.  Returns (store, should_sync)."""
+    should_sync = pay.epoch > s.epoch_id
+    # Highest commit certificate.
+    s2, _ = store_ops.insert_qc(p, s, weights, pay.hcc)
+    s = store_ops._sel(pay.hcc.valid, s2, s)
+    should_sync = should_sync | (
+        pay.hcc.valid
+        & ((pay.hcc.epoch > s.epoch_id)
+           | ((pay.hcc.epoch == s.epoch_id) & (pay.hcc.round > s.hcr + 2)))
+    )
+    # Highest QC.
+    s2, _ = store_ops.insert_qc(p, s, weights, pay.hqc)
+    s = store_ops._sel(pay.hqc.valid, s2, s)
+    should_sync = should_sync | (
+        pay.hqc.valid
+        & ((pay.hqc.epoch > s.epoch_id)
+           | ((pay.hqc.epoch == s.epoch_id) & (pay.hqc.round > s.hqc_round)))
+    )
+    # Proposed block, timeouts, vote (data_sync.rs:150-169).
+    s2, _ = store_ops.insert_block(p, s, weights, pay.prop_blk, pay.epoch)
+    s = store_ops._sel(pay.prop_blk.valid, s2, s)
+    s = _insert_timeout_batch(p, s, weights, pay.tc_to, pay.epoch)
+    s = _insert_timeout_batch(p, s, weights, pay.cur_to, pay.epoch)
+    s2, _ = store_ops.insert_vote(p, s, weights, pay.vote)
+    s = store_ops._sel(pay.vote.valid, s2, s)
+    return s, should_sync
+
+
+def handle_request(p: SimParams, s: Store, author, req: Payload) -> Payload:
+    """data_sync.rs:183-207 with the K-tail redesign of unknown_records."""
+    resp = create_notification(p, s, author)
+    # Walk back K QCs from our highest QC; emit ascending (blocks + QCs).
+    valids, rounds, vars_ = store_ops.qc_walk_back(
+        p, s, s.hqc_round > 0, s.hqc_round, s.hqc_var, p.chain_k
+    )
+    valids, rounds, vars_ = valids[::-1], rounds[::-1], vars_[::-1]
+
+    def emit(i):
+        bvar = s.qc_blk_var[_slot(p, rounds[i]), vars_[i]]
+        blk = blk_msg_at(p, s, rounds[i], bvar, valids[i])
+        qc = qc_msg_at(p, s, rounds[i], vars_[i], valids[i])
+        return blk, qc
+
+    blks, qcs = jax.vmap(emit)(jnp.arange(p.chain_k))
+    hcc_bvar = s.qc_blk_var[_slot(p, s.hcc_round), s.hcc_var]
+    hcc_blk = blk_msg_at(p, s, s.hcc_round, hcc_bvar, s.hcc_valid)
+    return resp.replace(
+        chain_blk=blks, chain_qc=qcs, hcc_blk=hcc_blk,
+        vote=resp.vote.replace(valid=jnp.bool_(False)),  # votes are skipped
+    )
+
+
+def handle_response(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights,
+                    pay: Payload):
+    """data_sync.rs:209-241 + state-sync jump.  Returns (store, nx, ctx)."""
+    # Decide whether normal chain replay can possibly connect.
+    gap_jump = pay.hqc.valid & (
+        (pay.epoch > s.epoch_id)
+        | (pay.hqc.round > s.hqc_round + (p.window - p.chain_k))
+    )
+    chain_has_base = pay.chain_qc.valid[0]
+    do_jump = gap_jump & chain_has_base
+    s_jump = _anchored_store(p, s, pay)
+    s = store_ops._sel(do_jump, s_jump, s)
+    nx = nx.replace(
+        latest_voted_round=jnp.where(do_jump, 0, nx.latest_voted_round),
+        locked_round=jnp.where(do_jump, 0, nx.locked_round),
+    )
+    # Adopt the committed state carried by the commit certificate on a jump.
+    adopt = do_jump & pay.hcc.valid & pay.hcc.commit_valid \
+        & (pay.hcc.commit_depth > ctx.last_depth)
+    ctx = ctx.replace(
+        last_depth=jnp.where(adopt, pay.hcc.commit_depth, ctx.last_depth),
+        last_tag=jnp.where(adopt, pay.hcc.commit_tag, ctx.last_tag),
+        sync_jumps=ctx.sync_jumps + jnp.where(do_jump, 1, 0),
+    )
+    # Replay the chain tail in ascending order: block then QC.
+    for i in range(p.chain_k):
+        skip_anchor = do_jump & (jnp.asarray(i) == 0)
+        blk = jax.tree.map(lambda x: x[i], pay.chain_blk)
+        qc = jax.tree.map(lambda x: x[i], pay.chain_qc)
+        s2, _ = store_ops.insert_block(p, s, weights, blk, pay.epoch)
+        s = store_ops._sel(blk.valid & ~skip_anchor, s2, s)
+        s2, _ = store_ops.insert_qc(p, s, weights, qc)
+        s = store_ops._sel(qc.valid & ~skip_anchor, s2, s)
+    # Highest commit certificate with its block, then the rest.
+    s2, _ = store_ops.insert_block(p, s, weights, pay.hcc_blk, pay.epoch)
+    s = store_ops._sel(pay.hcc_blk.valid, s2, s)
+    s2, _ = store_ops.insert_qc(p, s, weights, pay.hcc)
+    s = store_ops._sel(pay.hcc.valid, s2, s)
+    s = _insert_timeout_batch(p, s, weights, pay.tc_to, pay.epoch)
+    s = _insert_timeout_batch(p, s, weights, pay.cur_to, pay.epoch)
+    s2, _ = store_ops.insert_block(p, s, weights, pay.prop_blk, pay.epoch)
+    s = store_ops._sel(pay.prop_blk.valid, s2, s)
+    return s, nx, ctx
+
+
+def _anchored_store(p: SimParams, s: Store, pay: Payload) -> Store:
+    """Fresh store re-anchored at the base QC of the received chain: the base
+    QC becomes the 'initial' QC of the store (state-sync jump)."""
+    base_qc = jax.tree.map(lambda x: x[0], pay.chain_qc)
+    fresh = Store.initial(p)
+    return fresh.replace(
+        epoch_id=pay.epoch,
+        initial_round=base_qc.round,
+        initial_tag=base_qc.tag,
+        initial_state_depth=base_qc.state_depth,
+        initial_state_tag=base_qc.state_tag,
+        current_round=base_qc.round + 1,
+        hqc_round=base_qc.round,   # 'no QC beyond the anchor yet'
+        htc_round=base_qc.round,
+        hcr=base_qc.round,
+    )

@@ -1,0 +1,208 @@
+"""NodeState: the main per-node protocol loop ``update_node``.
+
+Tensor re-expression of ``impl ConsensusNode for NodeState``
+(/root/reference/librabft-v2/src/node.rs:206-305) + ``process_commits``
+(node.rs:308-352) + ``CommitTracker`` (node.rs:354-398).
+
+All functions operate on single-node slices (per-author axes keep their [N]
+dim); the simulator vmaps/indexes the node dim, and vmap over instances sits
+above that.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from . import pacemaker as pm_ops
+from . import store as store_ops
+from .types import NEVER, Context, NodeExtra, Pacemaker, SimParams, Store
+
+I32 = jnp.int32
+
+
+def _i32(x):
+    return jnp.asarray(x, I32)
+
+
+@struct.dataclass
+class NodeUpdateActions:
+    """NodeUpdateActions (/root/reference/bft-lib/src/interfaces.rs:12-21):
+    ``should_send``/``should_broadcast`` merged into one receiver mask."""
+
+    next_sched: jnp.ndarray    # NodeTime
+    send_mask: jnp.ndarray     # [N] bool — receivers of our notification
+    should_query_all: jnp.ndarray
+
+
+def update_node(
+    p: SimParams,
+    s: Store,
+    pm: Pacemaker,
+    nx: NodeExtra,
+    ctx: Context,
+    weights,
+    author,
+    clock,
+    dur_table,
+):
+    """One step of the protocol main loop (node.rs:240-304).
+
+    Returns (store, pm, node_extra, ctx, NodeUpdateActions).
+    """
+    n = p.n_nodes
+    author = _i32(author)
+    # --- Pacemaker update + its actions (node.rs:246-254, 177-204).
+    pm, pa = pm_ops.update_pacemaker(
+        p, pm, s, weights, author, s.epoch_id, nx.latest_query_all, clock, dur_table
+    )
+    send_mask = (jnp.arange(n) == pa.send_leader) & (pa.send_leader >= 0)
+    # Create a timeout; never vote at a round we timed out
+    # (process_pacemaker_actions, node.rs:191-196).
+    s_to, _ = store_ops.create_timeout(p, s, weights, author, pa.timeout_round)
+    s = store_ops._sel(pa.should_create_timeout, s_to, s)
+    nx = nx.replace(
+        latest_voted_round=jnp.where(
+            pa.should_create_timeout,
+            jnp.maximum(nx.latest_voted_round, pa.timeout_round),
+            nx.latest_voted_round,
+        )
+    )
+    # Propose a block (node.rs:197-200): fetch() always yields the next
+    # (author, index) command (simulated_context.rs:116-125).
+    s_pb, _ = store_ops.propose_block(
+        p, s, weights, author, pa.propose_prev_round, pa.propose_prev_tag,
+        clock, ctx.next_cmd_index,
+    )
+    s = store_ops._sel(pa.should_propose, s_pb, s)
+    ctx = ctx.replace(
+        next_cmd_index=ctx.next_cmd_index + jnp.where(pa.should_propose, 1, 0)
+    )
+
+    # --- Vote on the proposed block (node.rs:255-276).
+    has_prop = pm_ops.proposed_block_valid(pm, s)
+    bvar = jnp.maximum(s.proposed_var, 0)
+    block_round = s.current_round
+    sl = jnp.remainder(block_round, p.window)
+    proposer = s.blk_author[sl, bvar]
+    prev_r = store_ops.previous_round(p, s, block_round, bvar)
+    may_vote = has_prop & (block_round > nx.latest_voted_round) & (prev_r >= nx.locked_round)
+    second_prev = store_ops.second_previous_round(p, s, block_round, bvar)
+    nx = nx.replace(
+        latest_voted_round=jnp.where(may_vote, block_round, nx.latest_voted_round),
+        locked_round=jnp.where(
+            may_vote, jnp.maximum(nx.locked_round, second_prev), nx.locked_round
+        ),
+    )
+    s_v, vote_ok = store_ops.create_vote(p, s, weights, author, block_round, bvar)
+    voted = may_vote & vote_ok
+    s = store_ops._sel(may_vote, s_v, s)
+    # Send our vote to the proposer (replaces pacemaker's should_send,
+    # node.rs:271-274).
+    send_mask = jnp.where(voted, jnp.arange(n) == proposer, send_mask)
+
+    # --- Mint a QC if our proposal won (node.rs:277-283).
+    s, qc_created = store_ops.check_new_qc(p, s, weights, author)
+    broadcast = pa.should_broadcast | qc_created
+    next_sched = jnp.where(qc_created, _i32(clock), pa.next_sched)
+
+    # --- Deliver commits / switch epochs (node.rs:284-285, 308-352).
+    s, nx, ctx = process_commits(p, s, nx, ctx, weights)
+
+    # --- Commit tracker (node.rs:286-297, 363-397).
+    nx, tr_query_all, tr_next = update_tracker(p, nx, s, clock)
+    query_all = pa.should_query_all | tr_query_all
+    next_sched = jnp.minimum(next_sched, tr_next)
+    nx = nx.replace(
+        latest_query_all=jnp.where(query_all, _i32(clock), nx.latest_query_all)
+    )
+    send_mask = send_mask | jnp.where(broadcast, jnp.arange(n) != author, False)
+    actions = NodeUpdateActions(
+        next_sched=next_sched, send_mask=send_mask, should_query_all=query_all
+    )
+    return s, pm, nx, ctx, actions
+
+
+def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights):
+    """node.rs:313-351: deliver newly committed states to the context in
+    ascending round order; on an epoch boundary, rebuild the record store for
+    the new epoch and stop delivering."""
+    keep, rounds, depths, tags = store_ops.committed_states_after(p, s, nx.tracker_hcr)
+    H_ = p.commit_log
+
+    def deliver(carry, x):
+        (cc, lc_d, lc_t, lr, ld, lt, stopped, sw, sw_e, sw_d, sw_t) = carry
+        valid, r, d, t = x
+        do = valid & ~stopped & (d > lc_d)
+        # StateFinalizer::commit (simulated_context.rs:161-185): ring append.
+        pos = jnp.remainder(cc, H_)
+        lr = jnp.where(do, lr.at[pos].set(r), lr)
+        ld = jnp.where(do, ld.at[pos].set(d), ld)
+        lt = jnp.where(do, lt.at[pos].set(t), lt)
+        cc = cc + jnp.where(do, 1, 0)
+        lc_d = jnp.where(do, d, lc_d)
+        lc_t = jnp.where(do, t, lc_t)
+        # EpochReader::read_epoch_id = depth // commands_per_epoch
+        # (simulated_context.rs:200-207).
+        new_epoch = d // p.commands_per_epoch
+        switch = do & (new_epoch > s.epoch_id)
+        sw = sw | switch
+        sw_e = jnp.where(switch, new_epoch, sw_e)
+        sw_d = jnp.where(switch, d, sw_d)
+        sw_t = jnp.where(switch, t, sw_t)
+        stopped = stopped | switch
+        return (cc, lc_d, lc_t, lr, ld, lt, stopped, sw, sw_e, sw_d, sw_t), None
+
+    init = (
+        ctx.commit_count, ctx.last_depth, ctx.last_tag,
+        ctx.log_round, ctx.log_depth, ctx.log_tag,
+        jnp.bool_(False), jnp.bool_(False), _i32(0), _i32(0), jnp.zeros((), jnp.uint32),
+    )
+    (cc, lc_d, lc_t, lr, ld, lt, _, sw, sw_e, sw_d, sw_t), _ = jax.lax.scan(
+        deliver, init, (keep, rounds, depths, tags)
+    )
+    ctx = ctx.replace(
+        commit_count=cc, last_depth=lc_d, last_tag=lc_t,
+        log_round=lr, log_depth=ld, log_tag=lt,
+    )
+    # Epoch switch (node.rs:330-348): fresh record store anchored at the
+    # committed state; reset voting constraints.
+    s_new = new_epoch_store(p, s, sw_e, sw_d, sw_t)
+    s = store_ops._sel(sw, s_new, s)
+    nx = nx.replace(
+        latest_voted_round=jnp.where(sw, 0, nx.latest_voted_round),
+        locked_round=jnp.where(sw, 0, nx.locked_round),
+    )
+    return s, nx, ctx
+
+
+def new_epoch_store(p: SimParams, s: Store, epoch, state_depth, state_tag) -> Store:
+    """RecordStoreState::new for a later epoch (record_store.rs:169-198)."""
+    from ..utils import hashing as H
+
+    fresh = Store.initial(p)
+    return fresh.replace(
+        epoch_id=_i32(epoch),
+        initial_tag=H.epoch_initial_tag(jnp.asarray(epoch).astype(jnp.uint32)),
+        initial_state_depth=_i32(state_depth),
+        initial_state_tag=state_tag,
+    )
+
+
+def update_tracker(p: SimParams, nx: NodeExtra, s: Store, clock):
+    """CommitTracker::update_tracker (node.rs:363-397).
+    Returns (node_extra, should_query_all, next_sched)."""
+    epoch_adv = s.epoch_id > nx.tracker_epoch
+    commit_adv = s.hcr > nx.tracker_hcr
+    bump = epoch_adv | commit_adv
+    nx = nx.replace(
+        tracker_epoch=jnp.maximum(nx.tracker_epoch, s.epoch_id),
+        tracker_hcr=jnp.where(bump, s.hcr, nx.tracker_hcr),
+        tracker_commit_time=jnp.where(bump, _i32(clock), nx.tracker_commit_time),
+    )
+    deadline = jnp.maximum(nx.tracker_commit_time, nx.latest_query_all) \
+        + p.target_commit_interval
+    should_query_all = clock >= deadline
+    deadline = jnp.where(should_query_all, clock + p.target_commit_interval, deadline)
+    return nx, should_query_all, deadline

@@ -1,0 +1,124 @@
+"""Pacemaker: round synchronization, leader election, timeouts, query-all.
+
+Tensor re-expression of ``PacemakerState::update_pacemaker``
+(/root/reference/librabft-v2/src/pacemaker.rs:140-221).  Round durations
+(delta * n^gamma) come from a host-precomputed integer table; the query-all
+period (lambda * duration) uses 16.16 fixed-point — no device floats, so the
+oracle replays decisions bit-identically.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from . import config
+from . import store as store_ops
+from .types import NEVER, Pacemaker, SimParams, Store
+
+I32 = jnp.int32
+
+
+def _i32(x):
+    return jnp.asarray(x, I32)
+
+
+@struct.dataclass
+class PacemakerActions:
+    """PacemakerUpdateActions (/root/reference/librabft-v2/src/pacemaker.rs:17-31)."""
+
+    should_propose: jnp.ndarray       # bool; on top of (prev_round, prev_tag)
+    propose_prev_round: jnp.ndarray
+    propose_prev_tag: jnp.ndarray
+    should_create_timeout: jnp.ndarray  # bool, for `timeout_round`
+    timeout_round: jnp.ndarray
+    send_leader: jnp.ndarray          # author to sync with, -1 = none
+    should_broadcast: jnp.ndarray
+    should_query_all: jnp.ndarray
+    next_sched: jnp.ndarray           # NodeTime
+
+
+def round_duration(p: SimParams, dur_table, active_round, hcr):
+    """pacemaker.rs:111-124: duration(round) = delta * n^gamma with
+    n = round - (hcr > 0 ? hcr + 2 : 0)."""
+    hccr = jnp.where(hcr > 0, hcr + 2, 0)
+    n = jnp.clip(active_round - hccr, 0, p.dur_table_size - 1)
+    return dur_table[n]
+
+
+def update_pacemaker(
+    p: SimParams,
+    pm: Pacemaker,
+    s: Store,
+    weights,
+    author,
+    epoch_id,
+    latest_query_all,
+    clock,
+    dur_table,
+):
+    """pacemaker.rs:142-207.  Returns (new_pm, PacemakerActions)."""
+    active_round = jnp.maximum(s.hqc_round, s.htc_round) + 1
+    enter = (epoch_id > pm.active_epoch) | (
+        (epoch_id == pm.active_epoch) & (active_round > pm.active_round)
+    )
+    leader = config.leader_of_round(weights, active_round)
+    duration = round_duration(p, dur_table, active_round, s.hcr)
+    pm2 = Pacemaker(
+        active_epoch=jnp.where(enter, _i32(epoch_id), pm.active_epoch),
+        active_round=jnp.where(enter, active_round, pm.active_round),
+        active_leader=jnp.where(enter, leader, pm.active_leader),
+        round_start=jnp.where(enter, _i32(clock), pm.round_start),
+        round_duration=jnp.where(enter, duration, pm.round_duration),
+    )
+    send_leader = jnp.where(
+        enter & (pm2.active_leader != author), pm2.active_leader, _i32(-1)
+    )
+
+    next_sched = _i32(NEVER)
+    # Leader with no proposal yet -> propose on top of the highest QC.
+    has_prop = proposed_block_valid(pm2, s)
+    hqc_r, hqc_t = store_ops.hqc_ref(p, s)
+    should_propose = (pm2.active_leader == author) & ~has_prop
+    should_broadcast = should_propose
+    next_sched = jnp.where(should_propose, _i32(clock), next_sched)
+
+    has_to = store_ops.has_timeout(s, author, pm2.active_round)
+    timeout_deadline = pm2.round_start + pm2.round_duration
+    past_deadline = clock >= timeout_deadline
+    should_create_timeout = ~has_to & past_deadline
+    should_broadcast = should_broadcast | should_create_timeout
+    next_sched = jnp.where(
+        ~has_to & ~past_deadline, jnp.minimum(next_sched, timeout_deadline), next_sched
+    )
+    # Once we hold a timeout, enforce periodic query-all (pacemaker.rs:195-204).
+    period = (_i32(p.lam_fp) * pm2.round_duration) >> 16
+    qad = latest_query_all + period
+    should_query_all = has_to & (clock >= qad)
+    qad = jnp.where(should_query_all, clock + period, qad)
+    next_sched = jnp.where(has_to, jnp.minimum(next_sched, qad), next_sched)
+
+    actions = PacemakerActions(
+        should_propose=should_propose,
+        propose_prev_round=hqc_r,
+        propose_prev_tag=hqc_t,
+        should_create_timeout=should_create_timeout,
+        timeout_round=pm2.active_round,
+        send_leader=send_leader,
+        should_broadcast=should_broadcast,
+        should_query_all=should_query_all,
+        next_sched=next_sched,
+    )
+    return pm2, actions
+
+
+def proposed_block_valid(pm: Pacemaker, s: Store):
+    """RecordStore::proposed_block gating (record_store.rs:611-634): pacemaker
+    must be on the store's epoch/round, a leader must exist, and a legitimate
+    proposal must be recorded."""
+    return (
+        (pm.active_epoch == s.epoch_id)
+        & (pm.active_round == s.current_round)
+        & (pm.active_leader >= 0)
+        & (s.proposed_var >= 0)
+    )

@@ -1,0 +1,568 @@
+"""Tensorized record store: verification, insertion, vote aggregation, QC
+chaining and the commit rule.
+
+Re-expresses ``RecordStoreState``
+(/root/reference/librabft-v2/src/record_store.rs:93-541) as pure functions over
+the round-windowed tables in :class:`~librabft_simulator_tpu.core.types.Store`.
+Every function takes a *single-node* store slice (per-author axes retain their
+[N] dim) and returns a new slice; conditionality is expressed by computing the
+updated store and selecting per-field with the verification outcome, which keeps
+everything jit/vmap-friendly (no data-dependent Python control flow).
+
+Key mappings:
+  verify_network_record   -> the ``ok`` predicates inside each insert_*
+  try_insert_network_record -> insert_block / insert_vote / insert_qc / insert_timeout
+  update_current_round    -> update_current_round (record_store.rs:207-219)
+  update_commit_3chain_round -> update_commit_chain (record_store.rs:221-235),
+      generalized to ``params.commit_chain`` (3 = LibraBFTv2, 2 = HotStuff-style)
+  vote_committed_state    -> vote_committed_state (record_store.rs:237-255)
+  compute_state           -> compute_state (record_store.rs:426-454)
+  check_for_new_quorum_certificate -> check_new_qc (record_store.rs:702-738)
+  committed_states_after  -> committed_states_after (record_store.rs:557-574)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import config
+from .types import (
+    ELECTION_CLOSED,
+    ELECTION_ONGOING,
+    ELECTION_WON,
+    BlockMsg,
+    QcMsg,
+    SimParams,
+    Store,
+    VoteMsg,
+)
+from ..utils import hashing as H
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def _i32(x):
+    return jnp.asarray(x, I32)
+
+
+def _sel(ok, new, old):
+    """Per-field select of a whole struct/pytree on a scalar predicate."""
+    return jax.tree.map(lambda a, b: jnp.where(ok, a, b), new, old)
+
+
+def _slot(p: SimParams, r):
+    return jnp.remainder(_i32(r), p.window)
+
+
+# ---------------------------------------------------------------------------
+# Lookups
+# ---------------------------------------------------------------------------
+
+
+def blk_find(p: SimParams, s: Store, r, tag):
+    """Variant index of the block with content ``tag`` at round ``r``; -1 if
+    absent.  Replaces ``blocks: HashMap<BlockHash, Block>`` lookups."""
+    sl = _slot(p, r)
+    match = s.blk_valid[sl] & (s.blk_round[sl] == r) & (s.blk_tag[sl] == tag)
+    var = jnp.argmax(match).astype(I32)
+    return jnp.where(jnp.any(match), var, _i32(-1))
+
+
+def qc_find(p: SimParams, s: Store, r, tag):
+    sl = _slot(p, r)
+    match = s.qc_valid[sl] & (s.qc_round[sl] == r) & (s.qc_tag[sl] == tag)
+    var = jnp.argmax(match).astype(I32)
+    return jnp.where(jnp.any(match), var, _i32(-1))
+
+
+def hqc_ref(p: SimParams, s: Store):
+    """(round, tag) of the highest QC, or the initial QC
+    (record_store.rs:553-555)."""
+    sl = _slot(p, s.hqc_round)
+    has_qc = s.hqc_round > s.initial_round
+    tag = jnp.where(has_qc, s.qc_tag[sl, s.hqc_var], s.initial_tag)
+    return s.hqc_round, tag
+
+
+def _qc_state(p: SimParams, s: Store, r, var):
+    sl = _slot(p, r)
+    return s.qc_state_depth[sl, var], s.qc_state_tag[sl, var]
+
+
+def _blk_prev(p: SimParams, s: Store, r, var):
+    sl = _slot(p, r)
+    return s.blk_prev_round[sl, var], s.blk_prev_tag[sl, var]
+
+
+def _qc_blk_var(p: SimParams, s: Store, r, var):
+    sl = _slot(p, r)
+    return s.qc_blk_var[sl, var]
+
+
+def prev_qc_of_block(p: SimParams, s: Store, blk_round, blk_var):
+    """(found, prev_round, prev_var): the QC a block chains to; prev_var==-1
+    means the epoch-initial (or jump-anchor) QC."""
+    pr, pt = _blk_prev(p, s, blk_round, blk_var)
+    is_initial = (pr == s.initial_round) & (pt == s.initial_tag)
+    var = qc_find(p, s, pr, pt)
+    found = is_initial | (var >= 0)
+    return found, pr, jnp.where(is_initial, _i32(-1), var)
+
+
+def qc_walk_back(p: SimParams, s: Store, start_valid, start_round, start_var, steps):
+    """BackwardQuorumCertificateIterator (record_store.rs:137-166): from the QC
+    at (start_round, start_var), follow block->previous-QC links for ``steps``
+    hops.  Returns per-hop (valid, round, var) arrays, newest first."""
+
+    def body(carry, _):
+        alive, r, v = carry
+        emit = (alive, r, v)
+        bvar = _qc_blk_var(p, s, r, v)
+        found, pr, pv = prev_qc_of_block(p, s, r, bvar)
+        alive2 = alive & found & (pv >= 0)  # pv < 0 => reached the initial QC
+        return (alive2, jnp.where(alive2, pr, r), jnp.where(alive2, pv, v)), emit
+
+    init = (jnp.asarray(start_valid) & (start_round > s.initial_round),
+            _i32(start_round), _i32(start_var))
+    _, (valids, rounds, vars_) = jax.lax.scan(body, init, None, length=steps)
+    return valids, rounds, vars_
+
+
+# ---------------------------------------------------------------------------
+# Derived protocol values
+# ---------------------------------------------------------------------------
+
+
+def previous_round(p: SimParams, s: Store, blk_round, blk_var):
+    """Round of the QC a block extends (record_store.rs:588-598)."""
+    pr, _ = _blk_prev(p, s, blk_round, blk_var)
+    return pr
+
+
+def second_previous_round(p: SimParams, s: Store, blk_round, blk_var):
+    """record_store.rs:600-609."""
+    found, pr, pv = prev_qc_of_block(p, s, blk_round, blk_var)
+    at_initial = pv < 0
+    bvar = _qc_blk_var(p, s, pr, jnp.maximum(pv, 0))
+    pr2, _ = _blk_prev(p, s, pr, bvar)
+    return jnp.where(at_initial | ~found, s.initial_round, pr2)
+
+
+def vote_committed_state(p: SimParams, s: Store, blk_round, blk_var):
+    """(valid, depth, tag) of the state that the commit rule would finalize if
+    a QC formed on this block (record_store.rs:237-255), generalized to
+    ``commit_chain`` C: the C-1 QCs below the block must have contiguous
+    rounds; the oldest one's state is committed."""
+    C = p.commit_chain
+    r_top = _i32(blk_round)
+    found0, pr, pv = prev_qc_of_block(p, s, blk_round, blk_var)
+    valids, rounds, vars_ = qc_walk_back(
+        p, s, found0 & (pv >= 0), pr, jnp.maximum(pv, 0), C - 1
+    )
+    ok = jnp.bool_(True)
+    prev_r = r_top
+    for i in range(C - 1):
+        ok = ok & valids[i] & (prev_r == rounds[i] + 1)
+        prev_r = rounds[i]
+    d, t = _qc_state(p, s, rounds[C - 2], vars_[C - 2])
+    zero_d = _i32(0)
+    zero_t = jnp.zeros((), U32)
+    return ok, jnp.where(ok, d, zero_d), jnp.where(ok, t, zero_t)
+
+
+def compute_state(p: SimParams, s: Store, blk_round, blk_var):
+    """Execute the block's command on its parent state (record_store.rs:426-454
+    + CommandExecutor::compute): rolling hash, depth + 1."""
+    found, pr, pv = prev_qc_of_block(p, s, blk_round, blk_var)
+    at_initial = pv < 0
+    pd, pt = _qc_state(p, s, pr, jnp.maximum(pv, 0))
+    base_d = jnp.where(at_initial, s.initial_state_depth, pd)
+    base_t = jnp.where(at_initial, s.initial_state_tag, pt)
+    sl = _slot(p, blk_round)
+    tag = H.state_tag_next(
+        base_t,
+        s.blk_cmd_proposer[sl, blk_var],
+        s.blk_cmd_index[sl, blk_var],
+        s.blk_time[sl, blk_var],
+    )
+    return found, base_d + 1, tag
+
+
+def update_commit_chain(p: SimParams, s: Store, qc_round, qc_var) -> Store:
+    """The 3-chain (or C-chain) commit rule applied after inserting the QC at
+    (qc_round, qc_var) (record_store.rs:221-235)."""
+    C = p.commit_chain
+    valids, rounds, _ = qc_walk_back(p, s, True, qc_round, qc_var, C)
+    ok = jnp.bool_(True)
+    for i in range(C):
+        ok = ok & valids[i]
+        if i > 0:
+            ok = ok & (rounds[i - 1] == rounds[i] + 1)
+    r1 = rounds[C - 1]
+    ok = ok & (r1 > s.hcr)
+    return s.replace(
+        hcr=jnp.where(ok, r1, s.hcr),
+        hcc_valid=ok | s.hcc_valid,
+        hcc_round=jnp.where(ok, _i32(qc_round), s.hcc_round),
+        hcc_var=jnp.where(ok, _i32(qc_var), s.hcc_var),
+    )
+
+
+def update_current_round(s: Store, r) -> Store:
+    """Advance the round and clear per-round aggregation state
+    (record_store.rs:207-219)."""
+    adv = _i32(r) > s.current_round
+    z = jnp.zeros_like
+    return s.replace(
+        current_round=jnp.where(adv, _i32(r), s.current_round),
+        proposed_var=jnp.where(adv, _i32(-1), s.proposed_var),
+        vt_valid=jnp.where(adv, z(s.vt_valid), s.vt_valid),
+        to_valid=jnp.where(adv, z(s.to_valid), s.to_valid),
+        to_weight=jnp.where(adv, _i32(0), s.to_weight),
+        bal_used=jnp.where(adv, z(s.bal_used), s.bal_used),
+        bal_weight=jnp.where(adv, z(s.bal_weight), s.bal_weight),
+        bal_state_depth=jnp.where(adv, z(s.bal_state_depth), s.bal_state_depth),
+        bal_state_tag=jnp.where(adv, z(s.bal_state_tag), s.bal_state_tag),
+        election=jnp.where(adv, _i32(ELECTION_ONGOING), s.election),
+        won_var=jnp.where(adv, _i32(0), s.won_var),
+        won_slot=jnp.where(adv, _i32(0), s.won_slot),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Record tags (content hashes; core of record.rs signing identities)
+# ---------------------------------------------------------------------------
+
+
+def block_tag(epoch, round_, author, prev_round, prev_tag, time, cmd_proposer, cmd_index):
+    return H.fold(
+        H.TAG_BLOCK, _u(epoch), _u(round_), _u(author), _u(prev_round), prev_tag,
+        _u(time), _u(cmd_proposer), _u(cmd_index),
+    )
+
+
+def qc_tag(epoch, round_, blk_tag_, state_depth, state_tag, commit_valid,
+           commit_depth, commit_tag, votes_lo, votes_hi, author):
+    return H.fold(
+        H.TAG_QC, _u(epoch), _u(round_), blk_tag_, _u(state_depth), state_tag,
+        _u(commit_valid), _u(commit_depth), commit_tag, votes_lo, votes_hi, _u(author),
+    )
+
+
+def _u(x):
+    return jnp.asarray(x).astype(U32)
+
+
+def author_mask_words(mask):
+    """Pack a [N<=64] author bool mask into two uint32 words (votes digest)."""
+    n = mask.shape[-1]
+    idx = jnp.arange(n)
+    lo = jnp.sum(jnp.where(mask & (idx < 32), U32(1) << _u(jnp.minimum(idx, 31)), U32(0)),
+                 axis=-1, dtype=U32)
+    hi = jnp.sum(jnp.where(mask & (idx >= 32), U32(1) << _u(jnp.maximum(idx - 32, 0)), U32(0)),
+                 axis=-1, dtype=U32)
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# Insertions (verify_network_record + try_insert_network_record)
+# ---------------------------------------------------------------------------
+
+
+def _pick_variant(valid_col, round_col, tag_col, r, tag):
+    """Choose a table variant for a new record at round ``r``: reuse
+    stale/empty slots, detect duplicates, cap at V live variants.
+
+    Returns (var, is_dup, has_room)."""
+    stale0 = ~valid_col[0] | (round_col[0] != r)
+    stale1 = ~valid_col[1] | (round_col[1] != r)
+    dup0 = ~stale0 & (tag_col[0] == tag)
+    dup1 = ~stale1 & (tag_col[1] == tag)
+    is_dup = dup0 | dup1
+    var = jnp.where(stale0, _i32(0), jnp.where(stale1, _i32(1), _i32(-1)))
+    has_room = var >= 0
+    return var, is_dup, has_room
+
+
+def insert_block(p: SimParams, s: Store, weights, b: BlockMsg, rec_epoch):
+    """record_store.rs:263-291 (verify) + :466-476 (insert)."""
+    sl = _slot(p, b.round)
+    var, is_dup, has_room = _pick_variant(s.blk_valid[sl], s.blk_round[sl], s.blk_tag[sl],
+                                          b.round, b.tag)
+    prev_initial = (b.prev_round == s.initial_round) & (b.prev_tag == s.initial_tag)
+    prev_known = prev_initial | (qc_find(p, s, b.prev_round, b.prev_tag) >= 0)
+    in_window = b.round > s.current_round - p.window
+    ok = (
+        b.valid
+        & (rec_epoch == s.epoch_id)
+        & ~is_dup
+        & has_room
+        & prev_known
+        & (b.round > b.prev_round)  # rounds must be increasing; >=1 from initial
+        & in_window
+    )
+    var = jnp.maximum(var, 0)
+    s2 = s.replace(
+        blk_valid=s.blk_valid.at[sl, var].set(True),
+        blk_round=s.blk_round.at[sl, var].set(b.round),
+        blk_author=s.blk_author.at[sl, var].set(b.author),
+        blk_prev_round=s.blk_prev_round.at[sl, var].set(b.prev_round),
+        blk_prev_tag=s.blk_prev_tag.at[sl, var].set(b.prev_tag),
+        blk_time=s.blk_time.at[sl, var].set(b.time),
+        blk_cmd_proposer=s.blk_cmd_proposer.at[sl, var].set(b.cmd_proposer),
+        blk_cmd_index=s.blk_cmd_index.at[sl, var].set(b.cmd_index),
+        blk_tag=s.blk_tag.at[sl, var].set(b.tag),
+    )
+    # current_proposed_block (record_store.rs:468-474): only the legitimate
+    # leader's block at the current round becomes the proposal.
+    is_proposal = (
+        (b.round == s.current_round)
+        & (config.leader_of_round(weights, s.current_round) == b.author)
+    )
+    s2 = s2.replace(
+        proposed_var=jnp.where(is_proposal, var, s2.proposed_var),
+    )
+    return _sel(ok, s2, s), ok
+
+
+def insert_vote(p: SimParams, s: Store, weights, v: VoteMsg):
+    """record_store.rs:292-329 (verify) + :477-499 (insert + ballot)."""
+    bvar = blk_find(p, s, v.round, v.blk_tag)
+    cs_ok, cs_d, cs_t = vote_committed_state(p, s, v.round, jnp.maximum(bvar, 0))
+    commit_match = (v.commit_valid == cs_ok) & (
+        ~cs_ok | ((v.commit_depth == cs_d) & (v.commit_tag == cs_t))
+    )
+    author = jnp.clip(v.author, 0, p.n_nodes - 1)
+    ok = (
+        v.valid
+        & (v.epoch == s.epoch_id)
+        & (bvar >= 0)
+        & commit_match
+        & (v.round == s.current_round)
+        & ~s.vt_valid[author]
+    )
+    bvar = jnp.maximum(bvar, 0)
+    s2 = s.replace(
+        vt_valid=s.vt_valid.at[author].set(True),
+        vt_blk_var=s.vt_blk_var.at[author].set(bvar),
+        vt_state_depth=s.vt_state_depth.at[author].set(v.state_depth),
+        vt_state_tag=s.vt_state_tag.at[author].set(v.state_tag),
+        vt_commit_valid=s.vt_commit_valid.at[author].set(v.commit_valid),
+        vt_commit_depth=s.vt_commit_depth.at[author].set(v.commit_depth),
+        vt_commit_tag=s.vt_commit_tag.at[author].set(v.commit_tag),
+    )
+    # Ballot update (ElectionState::Ongoing only).
+    ongoing = s.election == ELECTION_ONGOING
+    m0 = s2.bal_used[bvar, 0] & (s2.bal_state_depth[bvar, 0] == v.state_depth) \
+        & (s2.bal_state_tag[bvar, 0] == v.state_tag)
+    m1 = s2.bal_used[bvar, 1] & (s2.bal_state_depth[bvar, 1] == v.state_depth) \
+        & (s2.bal_state_tag[bvar, 1] == v.state_tag)
+    slot = jnp.where(
+        m0, _i32(0),
+        jnp.where(m1, _i32(1),
+                  jnp.where(~s2.bal_used[bvar, 0], _i32(0),
+                            jnp.where(~s2.bal_used[bvar, 1], _i32(1), _i32(-1)))),
+    )
+    has_slot = slot >= 0
+    slot = jnp.maximum(slot, 0)
+    w = weights[author]
+    new_weight = s2.bal_weight[bvar, slot] + w
+    do_ballot = ongoing & has_slot
+    s3 = s2.replace(
+        bal_used=s2.bal_used.at[bvar, slot].set(True),
+        bal_weight=s2.bal_weight.at[bvar, slot].set(new_weight),
+        bal_state_depth=s2.bal_state_depth.at[bvar, slot].set(v.state_depth),
+        bal_state_tag=s2.bal_state_tag.at[bvar, slot].set(v.state_tag),
+    )
+    won = do_ballot & (new_weight >= config.quorum_threshold(weights))
+    s3 = s3.replace(
+        election=jnp.where(won, _i32(ELECTION_WON), s3.election),
+        won_var=jnp.where(won, bvar, s3.won_var),
+        won_slot=jnp.where(won, slot, s3.won_slot),
+    )
+    s_final = _sel(do_ballot, s3, s2)
+    return _sel(ok, s_final, s), ok
+
+
+def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
+    """record_store.rs:330-389 (verify) + :500-526 (insert).
+
+    Signature/weight re-verification of the vote list is modeled out: QCs in
+    this framework are only minted by ``check_new_qc`` holding a real quorum,
+    so a QC message is trusted like a valid signature set.  (Divergence note:
+    on a failed state re-execution the reference leaves the QC in its map but
+    skips the computed-value updates; we reject it entirely.)"""
+    sl = _slot(p, q.round)
+    var, is_dup, has_room = _pick_variant(s.qc_valid[sl], s.qc_round[sl], s.qc_tag[sl],
+                                          q.round, q.tag)
+    bvar = blk_find(p, s, q.round, q.blk_tag)
+    bvar_c = jnp.maximum(bvar, 0)
+    author_ok = s.blk_author[sl, bvar_c] == q.author
+    cs_ok, cs_d, cs_t = vote_committed_state(p, s, q.round, bvar_c)
+    commit_match = (q.commit_valid == cs_ok) & (
+        ~cs_ok | ((q.commit_depth == cs_d) & (q.commit_tag == cs_t))
+    )
+    exec_ok, st_d, st_t = compute_state(p, s, q.round, bvar_c)
+    state_match = exec_ok & (st_d == q.state_depth) & (st_t == q.state_tag)
+    in_window = q.round > s.current_round - p.window
+    ok = (
+        q.valid
+        & (q.epoch == s.epoch_id)
+        & ~is_dup
+        & has_room
+        & (bvar >= 0)
+        & author_ok
+        & commit_match
+        & state_match
+        & in_window
+    )
+    var = jnp.maximum(var, 0)
+    s2 = s.replace(
+        qc_valid=s.qc_valid.at[sl, var].set(True),
+        qc_round=s.qc_round.at[sl, var].set(q.round),
+        qc_blk_var=s.qc_blk_var.at[sl, var].set(bvar_c),
+        qc_state_depth=s.qc_state_depth.at[sl, var].set(q.state_depth),
+        qc_state_tag=s.qc_state_tag.at[sl, var].set(q.state_tag),
+        qc_commit_valid=s.qc_commit_valid.at[sl, var].set(q.commit_valid),
+        qc_commit_depth=s.qc_commit_depth.at[sl, var].set(q.commit_depth),
+        qc_commit_tag=s.qc_commit_tag.at[sl, var].set(q.commit_tag),
+        qc_author=s.qc_author.at[sl, var].set(q.author),
+        qc_tag=s.qc_tag.at[sl, var].set(q.tag),
+    )
+    newer = q.round > s2.hqc_round
+    s2 = s2.replace(
+        hqc_round=jnp.where(newer, q.round, s2.hqc_round),
+        hqc_var=jnp.where(newer, var, s2.hqc_var),
+    )
+    s2 = update_current_round(s2, q.round + 1)
+    s2 = update_commit_chain(p, s2, q.round, var)
+    return _sel(ok, s2, s), ok
+
+
+def insert_timeout(p: SimParams, s: Store, weights, t_epoch, t_round, t_hcbr, t_author):
+    """record_store.rs:390-415 (verify) + :527-538 (insert + TC formation)."""
+    author = jnp.clip(t_author, 0, p.n_nodes - 1)
+    ok = (
+        (t_epoch == s.epoch_id)
+        & (t_hcbr <= s.hqc_round)
+        & (t_round == s.current_round)
+        & ~s.to_valid[author]
+    )
+    new_weight = s.to_weight + weights[author]
+    s2 = s.replace(
+        to_valid=s.to_valid.at[author].set(True),
+        to_hcbr=s.to_hcbr.at[author].set(t_hcbr),
+        to_weight=new_weight,
+    )
+    tc = new_weight >= config.quorum_threshold(weights)
+    s3 = s2.replace(
+        tc_valid=s2.to_valid,
+        tc_hcbr=s2.to_hcbr,
+        htc_round=s2.current_round,
+    )
+    s3 = update_current_round(s3, s2.current_round + 1)
+    s2 = _sel(tc, s3, s2)
+    return _sel(ok, s2, s), ok
+
+
+# ---------------------------------------------------------------------------
+# Record creation (RecordStore create_* APIs)
+# ---------------------------------------------------------------------------
+
+
+def make_block_msg(p: SimParams, s: Store, author, prev_round, prev_tag, time,
+                   cmd_proposer, cmd_index, round_=None):
+    r = s.current_round if round_ is None else _i32(round_)
+    tag = block_tag(s.epoch_id, r, author, prev_round, prev_tag, time,
+                    cmd_proposer, cmd_index)
+    return BlockMsg(
+        valid=jnp.bool_(True), round=r, author=_i32(author),
+        prev_round=_i32(prev_round), prev_tag=prev_tag, time=_i32(time),
+        cmd_proposer=_i32(cmd_proposer), cmd_index=_i32(cmd_index), tag=tag,
+    )
+
+
+def propose_block(p: SimParams, s: Store, weights, author, prev_round, prev_tag,
+                  time, cmd_index):
+    """record_store.rs:655-674: fetch a command (proposer=author, running
+    index) and insert a block on top of ``prev``."""
+    b = make_block_msg(p, s, author, prev_round, prev_tag, time, author, cmd_index)
+    return insert_block(p, s, weights, b, s.epoch_id)
+
+
+def create_vote(p: SimParams, s: Store, weights, author, blk_round, blk_var):
+    """record_store.rs:676-700: execute the block, vote for the resulting
+    state.  Returns (store, ok) — ok False if execution failed."""
+    sl = _slot(p, blk_round)
+    cs_ok, cs_d, cs_t = vote_committed_state(p, s, blk_round, blk_var)
+    exec_ok, st_d, st_t = compute_state(p, s, blk_round, blk_var)
+    v = VoteMsg(
+        valid=exec_ok, epoch=s.epoch_id, round=_i32(blk_round),
+        blk_tag=s.blk_tag[sl, blk_var], state_depth=st_d, state_tag=st_t,
+        commit_valid=cs_ok, commit_depth=cs_d, commit_tag=cs_t, author=_i32(author),
+    )
+    s2, ins_ok = insert_vote(p, s, weights, v)
+    return s2, exec_ok & ins_ok
+
+
+def create_timeout(p: SimParams, s: Store, weights, author, round_):
+    """record_store.rs:636-649."""
+    return insert_timeout(p, s, weights, s.epoch_id, _i32(round_), s.hqc_round,
+                          _i32(author))
+
+
+def has_timeout(s: Store, author, round_):
+    """record_store.rs:651-653."""
+    return (_i32(round_) == s.current_round) & s.to_valid[jnp.clip(author, 0, None)]
+
+
+def check_new_qc(p: SimParams, s: Store, weights, author):
+    """record_store.rs:702-738: if our proposal won the election, mint the QC
+    from the recorded votes.  Returns (store, created)."""
+    won = s.election == ELECTION_WON
+    bvar = s.won_var
+    sl = _slot(p, s.current_round)
+    blk_author = s.blk_author[sl, bvar]
+    trigger = won & (blk_author == _i32(author))
+    st_d = s.bal_state_depth[bvar, s.won_slot]
+    st_t = s.bal_state_tag[bvar, s.won_slot]
+    cs_ok, cs_d, cs_t = vote_committed_state(p, s, s.current_round, bvar)
+    votes_mask = s.vt_valid & (s.vt_state_depth == st_d) & (s.vt_state_tag == st_t) \
+        & (s.vt_blk_var == bvar)
+    lo, hi = author_mask_words(votes_mask)
+    tag = qc_tag(s.epoch_id, s.current_round, s.blk_tag[sl, bvar], st_d, st_t,
+                 cs_ok, cs_d, cs_t, lo, hi, author)
+    q = QcMsg(
+        valid=trigger, epoch=s.epoch_id, round=s.current_round,
+        blk_tag=s.blk_tag[sl, bvar], state_depth=st_d, state_tag=st_t,
+        commit_valid=cs_ok, commit_depth=cs_d, commit_tag=cs_t,
+        author=_i32(author), tag=tag,
+    )
+    s2 = s.replace(election=jnp.where(trigger, _i32(ELECTION_CLOSED), s.election))
+    s3, _ = insert_qc(p, s2, weights, q)
+    return _sel(trigger, s3, s), trigger
+
+
+# ---------------------------------------------------------------------------
+# Commit extraction
+# ---------------------------------------------------------------------------
+
+
+def committed_states_after(p: SimParams, s: Store, after_round):
+    """record_store.rs:557-574: walk the highest-commit-certificate chain
+    backward, skip the newest C-1 QCs (not yet committed), collect states with
+    round > after_round.  Returns (valid[W], round[W], depth[W], tag[W]) in
+    ASCENDING round order (valid entries are right-aligned)."""
+    W = p.window
+    start_r = jnp.where(s.hcc_valid, s.hcc_round, _i32(0))
+    valids, rounds, vars_ = qc_walk_back(p, s, s.hcc_valid, start_r, s.hcc_var, W)
+    skip = p.commit_chain - 1
+    idx = jnp.arange(W)
+    keep = valids & (idx >= skip) & (rounds > _i32(after_round))
+    sls = jnp.remainder(rounds, W)
+    depths = s.qc_state_depth[sls, vars_]
+    tags = s.qc_state_tag[sls, vars_]
+    # Reverse to ascending-round order.
+    return keep[::-1], rounds[::-1], depths[::-1], tags[::-1]

@@ -1,0 +1,486 @@
+"""Tensor state containers for the batched LibraBFTv2 simulator.
+
+Layout philosophy (TPU-first): every piece of reference state becomes a
+fixed-shape padded int32/uint32 array; one ``SimState`` pytree holds one
+*instance* (a full network of N nodes + its event queue).  ``jax.vmap`` adds
+the instance batch dimension; ``jax.jit`` compiles the whole step; sharding
+over a ``jax.sharding.Mesh`` splits the instance dim across chips.
+
+Reference counterparts are cited per group.  Key redesigns:
+
+* Hash-map record stores (/root/reference/librabft-v2/src/record_store.rs:93)
+  -> round-windowed tables ``[W, V]``: slot = round % W, V=2 variants per
+  round (2 suffices: honest protocol has <=1 block/QC per round; the second
+  slot catches Byzantine equivocation so safety violations are *observable*).
+* ``BinaryHeap<ScheduledEvent>`` (/root/reference/bft-lib/src/simulator.rs:29)
+  -> fixed-capacity message table + one timer slot per node (the reference
+  cancels stale timers via ``ignore_scheduled_updates_until``; keeping only
+  the newest timer is behaviourally equivalent).
+* Unbounded ledger states -> rolling ``(depth, tag)`` pairs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+import jax.numpy as jnp
+from flax import struct
+
+from ..utils import hashing as H
+from ..utils import quantile
+
+Array = Any
+
+NEVER = np.int32(2**31 - 1)  # NodeTime::never() (/root/reference/bft-lib/src/base_types.rs:57)
+
+# Event kinds; priority at equal time is DESCENDING kind
+# (/root/reference/bft-lib/src/simulator.rs:149-161).
+KIND_NOTIFY = 0
+KIND_REQUEST = 1
+KIND_RESPONSE = 2
+KIND_TIMER = 3
+
+# Election states (/root/reference/librabft-v2/src/record_store.rs:125).
+ELECTION_ONGOING = 0
+ELECTION_WON = 1
+ELECTION_CLOSED = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class SimParams:
+    """Static (compile-time) simulation parameters.
+
+    Mirrors NodeConfig (/root/reference/librabft-v2/src/node.rs:76) + CLI args
+    (/root/reference/librabft-v2/src/main.rs) + tensor capacities.
+    """
+
+    n_nodes: int = 3
+    window: int = 16          # W: record-store round window
+    variants: int = 2         # V: slots per round
+    queue_cap: int = 32       # CM: in-flight messages per instance
+    chain_k: int = 4          # K: rounds of (block, QC) tail in a sync response
+    commit_log: int = 32      # H: per-node committed-state ring
+    # Protocol config (reference defaults from main.rs).
+    commands_per_epoch: int = 30000
+    target_commit_interval: int = 100000
+    delta: int = 20
+    gamma: float = 2.0
+    lam: float = 0.5          # lambda; fixed-point applied as (lam_fp * d) >> 16
+    commit_chain: int = 3     # 3 = LibraBFTv2 3-chain; 2 = HotStuff-style 2-chain
+    # Network.
+    delay_kind: str = "lognormal"
+    delay_mean: float = 10.0
+    delay_variance: float = 4.0
+    delay_pareto_scale: float = 5.0
+    delay_pareto_alpha: float = 1.5
+    drop_prob: float = 0.0
+    max_clock: int = 1000
+    dur_table_size: int = 64
+
+    @property
+    def lam_fp(self) -> int:
+        return int(self.lam * 65536)
+
+    @property
+    def drop_u32(self) -> int:
+        return min(int(self.drop_prob * 4294967296.0), 0xFFFFFFFF)
+
+    def delay_table(self) -> np.ndarray:
+        if self.delay_kind == "pareto":
+            return quantile.make_table(
+                "pareto", scale=self.delay_pareto_scale, alpha=self.delay_pareto_alpha
+            )
+        if self.delay_kind == "uniform":
+            return quantile.make_table(
+                "uniform",
+                low=max(self.delay_mean - 3 * self.delay_variance ** 0.5, 0.0),
+                high=self.delay_mean + 3 * self.delay_variance ** 0.5,
+            )
+        if self.delay_kind == "constant":
+            return quantile.make_table("constant", value=int(self.delay_mean))
+        return quantile.make_table(
+            "lognormal", mean=self.delay_mean, variance=self.delay_variance
+        )
+
+    def duration_table(self) -> np.ndarray:
+        """round-duration(n) = delta * n^gamma, precomputed in float64 on host
+        (/root/reference/librabft-v2/src/pacemaker.rs:111-124)."""
+        n = np.arange(self.dur_table_size, dtype=np.float64)
+        vals = np.floor(float(self.delta) * np.power(np.maximum(n, 0), self.gamma))
+        return np.minimum(vals, float(NEVER // 2)).astype(np.int32)
+
+
+def _zeros(shape, dtype=jnp.int32):
+    return jnp.zeros(shape, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Wire-format structs (message payload pieces). All fields int32/uint32/bool.
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class BlockMsg:
+    """Block_ (/root/reference/librabft-v2/src/record.rs:52-63)."""
+
+    valid: Array
+    round: Array
+    author: Array
+    prev_round: Array  # round of previous QC; 0 = epoch-initial QC
+    prev_tag: Array    # uint32 content tag of previous QC (or initial tag)
+    time: Array
+    cmd_proposer: Array
+    cmd_index: Array
+    tag: Array         # uint32 content tag of this block
+
+    @classmethod
+    def empty(cls, shape=()):
+        return cls(
+            valid=_zeros(shape, jnp.bool_), round=_zeros(shape), author=_zeros(shape),
+            prev_round=_zeros(shape), prev_tag=_zeros(shape, jnp.uint32),
+            time=_zeros(shape), cmd_proposer=_zeros(shape), cmd_index=_zeros(shape),
+            tag=_zeros(shape, jnp.uint32),
+        )
+
+
+@struct.dataclass
+class QcMsg:
+    """QuorumCertificate_ (/root/reference/librabft-v2/src/record.rs:83-99);
+    the vote list is replaced by a votes digest folded into ``tag``."""
+
+    valid: Array
+    epoch: Array
+    round: Array
+    blk_tag: Array       # uint32 tag of certified block (its round == round)
+    state_depth: Array
+    state_tag: Array     # uint32
+    commit_valid: Array  # bool: committed_state.is_some()
+    commit_depth: Array
+    commit_tag: Array    # uint32
+    author: Array
+    tag: Array           # uint32
+
+    @classmethod
+    def empty(cls, shape=()):
+        return cls(
+            valid=_zeros(shape, jnp.bool_), epoch=_zeros(shape), round=_zeros(shape),
+            blk_tag=_zeros(shape, jnp.uint32), state_depth=_zeros(shape),
+            state_tag=_zeros(shape, jnp.uint32), commit_valid=_zeros(shape, jnp.bool_),
+            commit_depth=_zeros(shape), commit_tag=_zeros(shape, jnp.uint32),
+            author=_zeros(shape), tag=_zeros(shape, jnp.uint32),
+        )
+
+
+@struct.dataclass
+class VoteMsg:
+    """Vote_ (/root/reference/librabft-v2/src/record.rs:66-80)."""
+
+    valid: Array
+    epoch: Array
+    round: Array
+    blk_tag: Array
+    state_depth: Array
+    state_tag: Array
+    commit_valid: Array
+    commit_depth: Array
+    commit_tag: Array
+    author: Array
+
+    @classmethod
+    def empty(cls, shape=()):
+        return cls(
+            valid=_zeros(shape, jnp.bool_), epoch=_zeros(shape), round=_zeros(shape),
+            blk_tag=_zeros(shape, jnp.uint32), state_depth=_zeros(shape),
+            state_tag=_zeros(shape, jnp.uint32), commit_valid=_zeros(shape, jnp.bool_),
+            commit_depth=_zeros(shape), commit_tag=_zeros(shape, jnp.uint32),
+            author=_zeros(shape),
+        )
+
+
+@struct.dataclass
+class TimeoutsMsg:
+    """A batch of Timeout_ records sharing one round
+    (/root/reference/librabft-v2/src/record.rs:102-111): per-author validity
+    mask + highest_certified_block_round."""
+
+    round: Array        # scalar round shared by the batch
+    valid: Array        # [N] bool
+    hcbr: Array         # [N]
+
+    @classmethod
+    def empty(cls, n, shape=()):
+        return cls(
+            round=_zeros(shape),
+            valid=_zeros(shape + (n,), jnp.bool_),
+            hcbr=_zeros(shape + (n,)),
+        )
+
+
+@struct.dataclass
+class Payload:
+    """Superset of DataSyncNotification / Request / Response
+    (/root/reference/librabft-v2/src/data_sync.rs:15-59), fixed shape.
+
+    Notifications use: epoch, hcc, hqc, tc_to, cur_to, vote, prop_blk.
+    Requests use: epoch, req_hqc_round, req_hcr.
+    Responses use: epoch, chain_* (K ascending (block, QC) pairs ending at the
+    sender's highest QC), hcc_blk+hcc, tc_to, cur_to, prop_blk.  Unbounded
+    reference responses are replaced by the K-tail + state-sync jumps.
+    """
+
+    epoch: Array
+    hcc: QcMsg
+    hqc: QcMsg
+    hcc_blk: BlockMsg
+    prop_blk: BlockMsg
+    vote: VoteMsg
+    tc_to: TimeoutsMsg
+    cur_to: TimeoutsMsg
+    chain_blk: BlockMsg   # fields have leading [K]
+    chain_qc: QcMsg       # fields have leading [K]
+    req_hqc_round: Array
+    req_hcr: Array
+
+    @classmethod
+    def empty(cls, n, k, shape=()):
+        return cls(
+            epoch=_zeros(shape),
+            hcc=QcMsg.empty(shape), hqc=QcMsg.empty(shape),
+            hcc_blk=BlockMsg.empty(shape), prop_blk=BlockMsg.empty(shape),
+            vote=VoteMsg.empty(shape),
+            tc_to=TimeoutsMsg.empty(n, shape), cur_to=TimeoutsMsg.empty(n, shape),
+            chain_blk=BlockMsg.empty(shape + (k,)), chain_qc=QcMsg.empty(shape + (k,)),
+            req_hqc_round=_zeros(shape), req_hcr=_zeros(shape),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Per-node record store (RecordStoreState, record_store.rs:93-119).
+# Field leading dims below are written for ONE node; in SimState every array
+# gains a leading [N] owner dim (and vmap adds the instance dim above that).
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class Store:
+    # Verified blocks table [W, V].
+    blk_valid: Array
+    blk_round: Array
+    blk_author: Array
+    blk_prev_round: Array
+    blk_prev_tag: Array
+    blk_time: Array
+    blk_cmd_proposer: Array
+    blk_cmd_index: Array
+    blk_tag: Array
+    # Verified QCs table [W, V].
+    qc_valid: Array
+    qc_round: Array
+    qc_blk_var: Array      # variant of certified block at slot qc_round % W
+    qc_state_depth: Array
+    qc_state_tag: Array
+    qc_commit_valid: Array
+    qc_commit_depth: Array
+    qc_commit_tag: Array
+    qc_author: Array
+    qc_tag: Array
+    # Votes at the current round, per author [N].
+    vt_valid: Array
+    vt_blk_var: Array
+    vt_state_depth: Array
+    vt_state_tag: Array
+    vt_commit_valid: Array
+    vt_commit_depth: Array
+    vt_commit_tag: Array
+    # Ballot (ElectionState::Ongoing, record_store.rs:125-134): weight per
+    # (block variant, state slot); 2 state slots per variant tolerate one
+    # bogus-state Byzantine vote per variant.
+    bal_used: Array        # [V, 2] bool
+    bal_weight: Array      # [V, 2]
+    bal_state_depth: Array # [V, 2]
+    bal_state_tag: Array   # [V, 2]
+    # Timeouts at the current round, per author [N].
+    to_valid: Array
+    to_hcbr: Array
+    to_weight: Array       # scalar: current_timeouts_weight
+    # Snapshot of the highest TC (record_store.rs:112): per author [N].
+    tc_valid: Array
+    tc_hcbr: Array
+    # Scalars.
+    epoch_id: Array
+    initial_round: Array       # round of the 'initial' QC (0 normally; the
+                               # anchor QC's round after a state-sync jump)
+    initial_tag: Array         # uint32: QuorumCertificateHash(hash(epoch_id))
+    initial_state_depth: Array
+    initial_state_tag: Array   # uint32
+    current_round: Array
+    proposed_var: Array        # variant of current_proposed_block, -1 = none
+    election: Array            # ELECTION_*
+    won_var: Array
+    won_slot: Array            # ballot state slot that won
+    hqc_round: Array           # 0 = initial
+    hqc_var: Array
+    htc_round: Array
+    hcr: Array                 # highest_committed_round
+    hcc_valid: Array           # bool
+    hcc_round: Array
+    hcc_var: Array
+
+    @classmethod
+    def initial(cls, p: SimParams, shape=()):
+        W, V, N = p.window, p.variants, p.n_nodes
+        wv = shape + (W, V)
+        na = shape + (N,)
+        v2 = shape + (V, 2)
+        init_tag = jnp.broadcast_to(H.epoch_initial_tag(0), shape).astype(jnp.uint32)
+        state0 = jnp.broadcast_to(H.initial_state_tag(), shape).astype(jnp.uint32)
+        return cls(
+            blk_valid=_zeros(wv, jnp.bool_), blk_round=_zeros(wv), blk_author=_zeros(wv),
+            blk_prev_round=_zeros(wv), blk_prev_tag=_zeros(wv, jnp.uint32),
+            blk_time=_zeros(wv), blk_cmd_proposer=_zeros(wv), blk_cmd_index=_zeros(wv),
+            blk_tag=_zeros(wv, jnp.uint32),
+            qc_valid=_zeros(wv, jnp.bool_), qc_round=_zeros(wv), qc_blk_var=_zeros(wv),
+            qc_state_depth=_zeros(wv), qc_state_tag=_zeros(wv, jnp.uint32),
+            qc_commit_valid=_zeros(wv, jnp.bool_), qc_commit_depth=_zeros(wv),
+            qc_commit_tag=_zeros(wv, jnp.uint32), qc_author=_zeros(wv),
+            qc_tag=_zeros(wv, jnp.uint32),
+            vt_valid=_zeros(na, jnp.bool_), vt_blk_var=_zeros(na),
+            vt_state_depth=_zeros(na), vt_state_tag=_zeros(na, jnp.uint32),
+            vt_commit_valid=_zeros(na, jnp.bool_), vt_commit_depth=_zeros(na),
+            vt_commit_tag=_zeros(na, jnp.uint32),
+            bal_used=_zeros(v2, jnp.bool_), bal_weight=_zeros(v2),
+            bal_state_depth=_zeros(v2), bal_state_tag=_zeros(v2, jnp.uint32),
+            to_valid=_zeros(na, jnp.bool_), to_hcbr=_zeros(na),
+            to_weight=_zeros(shape),
+            tc_valid=_zeros(na, jnp.bool_), tc_hcbr=_zeros(na),
+            epoch_id=_zeros(shape),
+            initial_round=_zeros(shape),
+            initial_tag=init_tag,
+            initial_state_depth=_zeros(shape),
+            initial_state_tag=state0,
+            current_round=jnp.ones(shape, jnp.int32),  # rounds start at 1
+            proposed_var=jnp.full(shape, -1, jnp.int32),
+            election=_zeros(shape), won_var=_zeros(shape), won_slot=_zeros(shape),
+            hqc_round=_zeros(shape), hqc_var=_zeros(shape), htc_round=_zeros(shape),
+            hcr=_zeros(shape), hcc_valid=_zeros(shape, jnp.bool_),
+            hcc_round=_zeros(shape), hcc_var=_zeros(shape),
+        )
+
+
+@struct.dataclass
+class Pacemaker:
+    """PacemakerState (/root/reference/librabft-v2/src/pacemaker.rs:59-78)."""
+
+    active_epoch: Array
+    active_round: Array
+    active_leader: Array       # -1 = none
+    round_start: Array         # NodeTime we entered the round
+    round_duration: Array
+
+    @classmethod
+    def initial(cls, shape=()):
+        return cls(
+            active_epoch=_zeros(shape), active_round=_zeros(shape),
+            active_leader=jnp.full(shape, -1, jnp.int32),
+            round_start=_zeros(shape), round_duration=_zeros(shape),
+        )
+
+
+@struct.dataclass
+class NodeExtra:
+    """NodeState scalar fields + CommitTracker
+    (/root/reference/librabft-v2/src/node.rs:28-60)."""
+
+    latest_voted_round: Array
+    locked_round: Array
+    latest_query_all: Array
+    tracker_epoch: Array
+    tracker_hcr: Array
+    tracker_commit_time: Array
+
+    @classmethod
+    def initial(cls, shape=()):
+        return cls(
+            latest_voted_round=_zeros(shape), locked_round=_zeros(shape),
+            latest_query_all=_zeros(shape), tracker_epoch=_zeros(shape),
+            tracker_hcr=_zeros(shape), tracker_commit_time=_zeros(shape),
+        )
+
+
+@struct.dataclass
+class Context:
+    """SimulatedContext analog
+    (/root/reference/bft-lib/src/simulated_context.rs:75-108): rolling-hash
+    ledger + committed-history ring."""
+
+    next_cmd_index: Array
+    commit_count: Array
+    last_depth: Array
+    last_tag: Array           # uint32
+    sync_jumps: Array
+    log_round: Array          # [H]
+    log_depth: Array          # [H]
+    log_tag: Array            # [H] uint32
+
+    @classmethod
+    def initial(cls, p: SimParams, shape=()):
+        h = shape + (p.commit_log,)
+        return cls(
+            next_cmd_index=_zeros(shape), commit_count=_zeros(shape),
+            last_depth=_zeros(shape),
+            last_tag=jnp.broadcast_to(H.initial_state_tag(), shape).astype(jnp.uint32),
+            sync_jumps=_zeros(shape),
+            log_round=_zeros(h), log_depth=_zeros(h), log_tag=_zeros(h, jnp.uint32),
+        )
+
+
+@struct.dataclass
+class Queue:
+    """Fixed-capacity network-message table (replaces the BinaryHeap,
+    /root/reference/bft-lib/src/simulator.rs:29)."""
+
+    valid: Array     # [CM] bool
+    time: Array      # [CM] global time
+    kind: Array      # [CM]
+    stamp: Array     # [CM]
+    sender: Array    # [CM]
+    receiver: Array  # [CM]
+    payload: Payload # fields with leading [CM]
+
+    @classmethod
+    def initial(cls, p: SimParams, shape=()):
+        cm = shape + (p.queue_cap,)
+        return cls(
+            valid=_zeros(cm, jnp.bool_), time=_zeros(cm), kind=_zeros(cm),
+            stamp=_zeros(cm), sender=_zeros(cm), receiver=_zeros(cm),
+            payload=Payload.empty(p.n_nodes, p.chain_k, cm),
+        )
+
+
+@struct.dataclass
+class SimState:
+    """One simulated instance: N nodes + network.  vmap over a leading batch
+    dim gives the fleet (Simulator, /root/reference/bft-lib/src/simulator.rs:26)."""
+
+    store: Store          # fields [N, ...]
+    pm: Pacemaker         # fields [N]
+    node: NodeExtra       # fields [N]
+    ctx: Context          # fields [N, ...]
+    queue: Queue
+    timer_time: Array     # [N] global time of each node's (single) pending timer
+    timer_stamp: Array    # [N]
+    startup: Array        # [N] startup_time (global)
+    weights: Array        # [N] voting rights
+    byz_equivocate: Array # [N] bool
+    byz_silent: Array     # [N] bool
+    clock: Array          # global clock
+    stamp_ctr: Array      # event/rng counter
+    halted: Array         # bool
+    seed: Array           # uint32 instance seed
+    # Metrics.
+    n_events: Array
+    n_msgs_sent: Array
+    n_msgs_dropped: Array
+    n_queue_full: Array

@@ -1,0 +1,331 @@
+"""Batched discrete-event simulator: the jittable, vmappable step function.
+
+Tensor re-expression of ``Simulator``/``loop_until``
+(/root/reference/bft-lib/src/simulator.rs:26-476).  One :class:`SimState`
+pytree holds one instance (N nodes + queue); ``step`` processes exactly one
+event; ``jax.vmap(step)`` runs the fleet; ``lax.scan`` unrolls time;
+``jax.jit`` compiles the whole thing.
+
+Event selection replaces the BinaryHeap with a lexicographic argmin over
+(time asc, kind desc, stamp asc) — the exact ordering of ScheduledEvent::cmp
+(simulator.rs:149-161).  Timers live in one slot per node (equivalent to the
+reference's ignore_scheduled_updates_until cancellation, simulator.rs:311-323).
+
+Known, self-consistent divergences from the reference (the oracle replays the
+same semantics, so parity holds):
+  * receivers are enumerated in index order, not shuffled (simulator.rs:343);
+  * notification/request payloads snapshot the post-update node state;
+  * message drops and queue overflow (counted) replace unbounded heaps.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import data_sync, node as node_ops, store as store_ops
+from ..core.types import (
+    KIND_NOTIFY,
+    KIND_REQUEST,
+    KIND_RESPONSE,
+    KIND_TIMER,
+    NEVER,
+    Context,
+    NodeExtra,
+    Pacemaker,
+    Payload,
+    Queue,
+    SimParams,
+    SimState,
+    Store,
+)
+from ..utils import hashing as H
+from ..utils.quantile import TABLE_BITS
+
+I32 = jnp.int32
+EQUIV_SALT = 1 << 20  # command-index offset of an equivocating second proposal
+
+
+def _i32(x):
+    return jnp.asarray(x, I32)
+
+
+def _node_slice(tree, a):
+    return jax.tree.map(lambda x: x[a], tree)
+
+
+def _node_update(tree, a, new):
+    return jax.tree.map(lambda x, v: x.at[a].set(v), tree, new)
+
+
+def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
+               byz_equivocate=None, byz_silent=None) -> SimState:
+    """Simulator::new (simulator.rs:200-250): per-node random startup times,
+    initial timers at local time 0."""
+    n = p.n_nodes
+    seed = jnp.asarray(seed).astype(jnp.uint32)
+    delay_table = jnp.asarray(p.delay_table())
+    draws = jax.vmap(lambda c: H.rng_u32(seed, c.astype(jnp.uint32)))(jnp.arange(n))
+    startup = delay_table[(draws >> (32 - TABLE_BITS)).astype(I32)] + 1
+    if weights is None:
+        weights = jnp.ones((n,), I32)
+    if byz_equivocate is None:
+        byz_equivocate = jnp.zeros((n,), jnp.bool_)
+    if byz_silent is None:
+        byz_silent = jnp.zeros((n,), jnp.bool_)
+    return SimState(
+        store=Store.initial(p, (n,)),
+        pm=Pacemaker.initial((n,)),
+        node=NodeExtra.initial((n,)),
+        ctx=Context.initial(p, (n,)),
+        queue=Queue.initial(p),
+        timer_time=startup.astype(I32),
+        timer_stamp=jnp.arange(n, dtype=I32),
+        startup=startup.astype(I32),
+        weights=jnp.asarray(weights, I32),
+        byz_equivocate=jnp.asarray(byz_equivocate, jnp.bool_),
+        byz_silent=jnp.asarray(byz_silent, jnp.bool_),
+        clock=_i32(0),
+        stamp_ctr=_i32(n),
+        halted=jnp.bool_(False),
+        seed=seed,
+        n_events=_i32(0),
+        n_msgs_sent=_i32(0),
+        n_msgs_dropped=_i32(0),
+        n_queue_full=_i32(0),
+    )
+
+
+def _select_event(p: SimParams, st: SimState):
+    """Lexicographic (time, kind desc, stamp) argmin over messages + timers."""
+    cm = p.queue_cap
+    msg_time = jnp.where(st.queue.valid, st.queue.time, NEVER)
+    all_time = jnp.concatenate([msg_time, st.timer_time])
+    all_kind = jnp.concatenate([st.queue.kind, jnp.full((p.n_nodes,), KIND_TIMER, I32)])
+    all_stamp = jnp.concatenate([st.queue.stamp, st.timer_stamp])
+    t_min = jnp.min(all_time)
+    c1 = all_time == t_min
+    k_best = jnp.max(jnp.where(c1, all_kind, -1))
+    c2 = c1 & (all_kind == k_best)
+    s_best = jnp.min(jnp.where(c2, all_stamp, NEVER))
+    idx = jnp.argmax(c2 & (all_stamp == s_best)).astype(I32)
+    return idx, t_min, idx >= cm
+
+
+def _equivocated_payload(p: SimParams, s_a, author, pay: Payload) -> Payload:
+    """Second, conflicting proposal for Byzantine equivocation sweeps."""
+    b = pay.prop_blk
+    tag = store_ops.block_tag(
+        s_a.epoch_id, b.round, b.author, b.prev_round, b.prev_tag, b.time,
+        b.cmd_proposer, b.cmd_index + EQUIV_SALT,
+    )
+    return pay.replace(
+        prop_blk=b.replace(cmd_index=b.cmd_index + EQUIV_SALT, tag=tag),
+        vote=pay.vote.replace(valid=jnp.bool_(False)),
+    )
+
+
+def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
+    """Process one event of one instance (loop_until body, simulator.rs:380-468)."""
+    n, cm, k_chain = p.n_nodes, p.queue_cap, p.chain_k
+    idx, t_min, is_timer = _select_event(p, st)
+    halt = st.halted | (t_min > p.max_clock)
+    live = ~halt
+    clock = jnp.maximum(st.clock, jnp.minimum(t_min, NEVER - 1))
+    midx = jnp.minimum(idx, cm - 1)
+    kind = jnp.where(is_timer, _i32(KIND_TIMER), st.queue.kind[midx])
+    a = jnp.where(is_timer, idx - cm, st.queue.receiver[midx]).astype(I32)
+    a = jnp.clip(a, 0, n - 1)
+    sender = st.queue.sender[midx]
+    pay_in = _node_slice(st.queue.payload, midx)
+    # Consume the message slot.
+    queue = st.queue.replace(valid=st.queue.valid.at[midx].set(
+        jnp.where(live & ~is_timer, False, st.queue.valid[midx])))
+
+    # ---- Node slices.
+    s_a = _node_slice(st.store, a)
+    pm_a = _node_slice(st.pm, a)
+    nx_a = _node_slice(st.node, a)
+    cx_a = _node_slice(st.ctx, a)
+    local_clock = clock - st.startup[a]
+
+    # ---- Handlers (all computed, masked by kind; vmap would de-branch
+    # lax.switch anyway).
+    is_notify = live & ~is_timer & (kind == KIND_NOTIFY)
+    is_request = live & ~is_timer & (kind == KIND_REQUEST)
+    is_response = live & ~is_timer & (kind == KIND_RESPONSE)
+    do_update = live & (is_timer | is_notify | is_response)
+
+    s_n, should_sync = data_sync.handle_notification(p, s_a, st.weights, pay_in)
+    s_r, nx_r, cx_r = data_sync.handle_response(p, s_a, nx_a, cx_a, st.weights, pay_in)
+    s_in = store_ops._sel(is_notify, s_n, store_ops._sel(is_response, s_r, s_a))
+    nx_in = store_ops._sel(is_response, nx_r, nx_a)
+    cx_in = store_ops._sel(is_response, cx_r, cx_a)
+
+    s_u, pm_u, nx_u, cx_u, actions = node_ops.update_node(
+        p, s_in, pm_a, nx_in, cx_in, st.weights, a, local_clock, dur_table
+    )
+    s_f = store_ops._sel(do_update, s_u, s_in)
+    pm_f = store_ops._sel(do_update, pm_u, pm_a)
+    nx_f = store_ops._sel(do_update, nx_u, nx_in)
+    cx_f = store_ops._sel(do_update, cx_u, cx_in)
+
+    # ---- Outgoing messages.
+    notif = data_sync.create_notification(p, s_f, a)
+    notif_b = _equivocated_payload(p, s_f, a, notif)
+    request = data_sync.create_request(p, s_f)
+    response = data_sync.handle_request(p, s_f, a, pay_in)
+    payload_bank = jax.tree.map(
+        lambda *xs: jnp.stack(xs), notif, notif_b, request, response
+    )
+
+    silent = st.byz_silent[a]
+    others = jnp.arange(n) != a
+    # Candidate order fixes the stamp sequence: [sync-request or response] then
+    # (timer stamp) then notifications then query-all requests.
+    want_sync_req = is_notify & should_sync & ~silent
+    want_response = is_request & ~silent
+    cand0_want = want_sync_req | want_response
+    cand0_kind = jnp.where(want_response, _i32(KIND_RESPONSE), _i32(KIND_REQUEST))
+    cand0_recv = jnp.clip(sender, 0, n - 1)
+    cand0_pay = jnp.where(want_response, _i32(3), _i32(2))
+
+    send_mask = actions.send_mask & others & do_update & ~silent
+    # Equivocators send the conflicting proposal to the upper half of receivers.
+    upper = jnp.arange(n) >= (a + 1 + (n - 1) // 2 + 1)
+    upper = (jnp.arange(n) * 2 >= n)  # receivers in the upper index half
+    notif_sel = jnp.where(st.byz_equivocate[a] & upper, _i32(1), _i32(0))
+    query_mask = jnp.where(actions.should_query_all & do_update & ~silent, others, False)
+
+    want = jnp.concatenate([cand0_want[None], send_mask, query_mask])
+    kinds = jnp.concatenate([
+        cand0_kind[None],
+        jnp.full((n,), KIND_NOTIFY, I32),
+        jnp.full((n,), KIND_REQUEST, I32),
+    ])
+    recvs = jnp.concatenate([cand0_recv[None], jnp.arange(n, dtype=I32),
+                             jnp.arange(n, dtype=I32)])
+    pay_sel = jnp.concatenate([cand0_pay[None], notif_sel, jnp.full((n,), 2, I32)])
+
+    # Stamps: candidate 0, then one for the timer reschedule, then the rest.
+    pos_in_want = jnp.cumsum(want) - 1
+    timer_gap = jnp.where(do_update, 1, 0)
+    stamps = st.stamp_ctr + pos_in_want + jnp.where(jnp.arange(2 * n + 1) > 0, timer_gap, 0)
+    total_consumed = jnp.sum(want) + timer_gap
+    timer_stamp_new = st.stamp_ctr + jnp.where(cand0_want, 1, 0)
+
+    # Delays + drops (schedule_network_event, simulator.rs:266-269).
+    u_delay = jax.vmap(lambda c: H.rng_u32(st.seed, c.astype(jnp.uint32)))(stamps)
+    u_drop = jax.vmap(lambda c: H.mix32(c, jnp.uint32(0x632BE59B)))(u_delay)
+    delays = delay_table[(u_delay >> (32 - TABLE_BITS)).astype(I32)]
+    dropped = want & (u_drop < jnp.uint32(p.drop_u32))
+    arrive = clock + delays
+
+    # Free-slot assignment.
+    go = want & ~dropped
+    free = ~queue.valid
+    n_free = jnp.sum(free)
+    rank = jnp.cumsum(go) - 1
+    free_rank = jnp.cumsum(free) - 1
+    # slot_of_rank[r] = index of r-th free slot
+    slot_of_rank = jnp.full((2 * n + 1,), -1, I32).at[
+        jnp.where(free, free_rank, 2 * n + 1)
+    ].set(jnp.arange(cm, dtype=I32), mode="drop")
+    overflow = go & (rank >= n_free)
+    tgt = jnp.where(go & ~overflow, slot_of_rank[jnp.clip(rank, 0, 2 * n)], _i32(-1))
+
+    out_pay = jax.tree.map(lambda bank: bank[pay_sel], payload_bank)
+    queue = queue.replace(
+        valid=queue.valid.at[tgt].set(True, mode="drop"),
+        time=queue.time.at[tgt].set(arrive, mode="drop"),
+        kind=queue.kind.at[tgt].set(kinds, mode="drop"),
+        stamp=queue.stamp.at[tgt].set(stamps, mode="drop"),
+        sender=queue.sender.at[tgt].set(a, mode="drop"),
+        receiver=queue.receiver.at[tgt].set(recvs, mode="drop"),
+        payload=jax.tree.map(
+            lambda qf, of: qf.at[tgt].set(of, mode="drop"), queue.payload, out_pay
+        ),
+    )
+
+    # ---- Timer reschedule (process_node_actions, simulator.rs:310-324).
+    next_g = jnp.where(
+        actions.next_sched >= NEVER, NEVER,
+        jnp.minimum(actions.next_sched + st.startup[a], NEVER),
+    )
+    new_timer = jnp.maximum(next_g, clock + 1)
+    timer_time = jnp.where(do_update, st.timer_time.at[a].set(new_timer), st.timer_time)
+    timer_stamp = jnp.where(
+        do_update, st.timer_stamp.at[a].set(timer_stamp_new), st.timer_stamp
+    )
+
+    return st.replace(
+        store=_node_update(st.store, a, s_f),
+        pm=_node_update(st.pm, a, pm_f),
+        node=_node_update(st.node, a, nx_f),
+        ctx=_node_update(st.ctx, a, cx_f),
+        queue=queue,
+        timer_time=timer_time,
+        timer_stamp=timer_stamp,
+        clock=jnp.where(live, clock, st.clock),
+        stamp_ctr=st.stamp_ctr + jnp.where(live, total_consumed, 0),
+        halted=halt,
+        n_events=st.n_events + jnp.where(live, 1, 0),
+        n_msgs_sent=st.n_msgs_sent + jnp.where(live, jnp.sum(go & ~overflow), 0),
+        n_msgs_dropped=st.n_msgs_dropped + jnp.where(live, jnp.sum(dropped), 0),
+        n_queue_full=st.n_queue_full + jnp.where(live, jnp.sum(overflow), 0),
+    )
+
+
+def make_step_fn(p: SimParams, batched: bool = True):
+    """Compiled step over a [B, ...] batch of instances."""
+    delay_table = jnp.asarray(p.delay_table())
+    dur_table = jnp.asarray(p.duration_table())
+    f = functools.partial(step, p, delay_table, dur_table)
+    if batched:
+        f = jax.vmap(f)
+    return jax.jit(f, donate_argnums=(0,))
+
+
+def make_run_fn(p: SimParams, num_steps: int, batched: bool = True):
+    """lax.scan of ``num_steps`` events per instance (loop_until)."""
+    delay_table = jnp.asarray(p.delay_table())
+    dur_table = jnp.asarray(p.duration_table())
+
+    def run(st):
+        def body(s, _):
+            return step(p, delay_table, dur_table, s), ()
+
+        st, _ = jax.lax.scan(body, st, None, length=num_steps)
+        return st
+
+    if batched:
+        run = jax.vmap(run)
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def dedupe_buffers(st):
+    """Give every leaf its own buffer (jnp.zeros constants are cached and
+    aliased across fields, which breaks buffer donation)."""
+    return jax.tree.map(lambda x: jnp.array(x, copy=True), st)
+
+
+def run_to_completion(p: SimParams, st: SimState, chunk: int = 512,
+                      max_chunks: int = 200, batched: bool = False):
+    """Host loop: run until every instance passes max_clock (for tests)."""
+    run = make_run_fn(p, chunk, batched=batched)
+    st = dedupe_buffers(st)
+    for _ in range(max_chunks):
+        st = run(st)
+        halted = jax.device_get(st.halted)
+        if np.all(halted):
+            break
+    return st
+
+
+def init_batch(p: SimParams, seeds) -> SimState:
+    """vmapped init over an array of instance seeds."""
+    seeds = jnp.asarray(seeds).astype(jnp.uint32)
+    return jax.vmap(lambda s: init_state(p, s))(seeds)

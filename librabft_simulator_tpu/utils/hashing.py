@@ -1,0 +1,96 @@
+"""Deterministic integer hashing / RNG used across the TPU path and the oracle.
+
+The reference simulator uses Rust's ``DefaultHasher`` + BCS bytes for record
+hashing (``/root/reference/bft-lib/src/simulated_context.rs:238``) and
+``Xoshiro256StarStar`` for random delays and author picking
+(``/root/reference/bft-lib/src/configuration.rs:65``,
+``/root/reference/bft-lib/src/simulator.rs:110``).
+
+TPU-first redesign: everything is uint32 lane arithmetic (wrapping), built
+from murmur3-style finalizer rounds.  The exact same functions are
+re-implemented in pure Python in ``librabft_simulator_tpu/oracle/engine.py``
+(masked with ``& 0xFFFFFFFF``), giving bit-identical results on CPU, TPU and
+in the oracle — no float transcendentals, no 64-bit requirement on device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+# Domain-separation tags for record hashing (arbitrary odd constants).
+TAG_BLOCK = 0x9E3779B1
+TAG_VOTE = 0x85EBCA77
+TAG_QC = 0xC2B2AE3D
+TAG_TIMEOUT = 0x27D4EB2F
+TAG_STATE = 0x165667B1
+TAG_EPOCH = 0x5851F42D
+TAG_LEADER = 0x2545F491
+TAG_SEED = 0x9E447687
+
+
+def _u32(x):
+    if isinstance(x, (int, bool)):
+        return U32(x & 0xFFFFFFFF)
+    return jnp.asarray(x).astype(U32)
+
+
+def mix32(h, x):
+    """Fold one uint32 word ``x`` into accumulator ``h`` (murmur3 fmix rounds)."""
+    h = _u32(h) ^ _u32(x)
+    h = h * U32(0x9E3779B1)
+    h = h ^ (h >> U32(16))
+    h = h * U32(0x85EBCA6B)
+    h = h ^ (h >> U32(13))
+    h = h * U32(0xC2B2AE35)
+    h = h ^ (h >> U32(16))
+    return h
+
+
+def fold(*words):
+    """Hash a sequence of uint32-like words into a single uint32 tag."""
+    h = U32(0x811C9DC5)
+    for w in words:
+        h = mix32(h, w)
+    return h
+
+
+def rng_u32(seed, counter):
+    """Counter-based uniform uint32: stream ``seed``, index ``counter``.
+
+    Replaces the reference's sequential Xoshiro stream
+    (/root/reference/bft-lib/src/simulator.rs:32) with a counter-based design
+    so draws are order-independent within a jitted step and can be replayed
+    exactly by the oracle.
+    """
+    return fold(TAG_SEED, seed, counter)
+
+
+def rng_u32_pair(seed, counter):
+    """Two independent uint32 draws for one counter (delay + drop decision)."""
+    a = fold(TAG_SEED, seed, counter)
+    b = mix32(a, U32(0x632BE59B))
+    return a, b
+
+
+def state_tag_next(prev_tag, cmd_proposer, cmd_index, time):
+    """Rolling ledger-state hash: executing one command on top of prev state.
+
+    Capability analog of SimulatedLedgerState::key()
+    (/root/reference/bft-lib/src/simulated_context.rs:51): the reference hashes
+    the whole execution history; we keep a rolling (depth, tag) pair instead.
+    """
+    return fold(TAG_STATE, prev_tag, _u32(cmd_proposer), _u32(cmd_index), _u32(time))
+
+
+def epoch_initial_tag(epoch_id):
+    """Initial QC 'hash' for an epoch (reference: hash(&epoch_id),
+    /root/reference/librabft-v2/src/node.rs:116)."""
+    return fold(TAG_EPOCH, _u32(epoch_id))
+
+
+def initial_state_tag():
+    """Tag of the empty ledger state (reference: hash of empty history)."""
+    return fold(TAG_STATE, U32(0))

@@ -1,0 +1,13 @@
+import os
+
+# Virtual 8-device CPU mesh for tests; must happen before any jax computation.
+# (The axon TPU plugin ignores the JAX_PLATFORMS env var, so we also set the
+# config flag explicitly.)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
