@@ -1,0 +1,107 @@
+"""Round-contract benchmark: aggregate consensus-round throughput.
+
+Runs a fleet of independent LibraBFTv2 instances (BASELINE config #2 shape:
+4 nodes per instance) as one jitted, vmapped step function and reports
+
+    {"metric": "rounds_per_sec", "value": ..., "unit": "rounds/sec",
+     "vs_baseline": value / 1e6, ...}
+
+on a single line of stdout.  ``vs_baseline`` is against the reference north
+star of >=1M consensus rounds/sec aggregate (BASELINE.json).
+
+Environment knobs: BENCH_B (instances), BENCH_STEPS (timed events/instance),
+BENCH_NODES, BENCH_SWEEP=1 to also print per-config lines for BASELINE
+configs 1-5 (stderr, not the contract line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import simulator as S
+
+
+def fleet_rounds(st) -> int:
+    """Rounds completed per instance = max round any of its nodes reached
+    (current_round starts at 1); summed over the fleet."""
+    cur = jax.device_get(st.store.current_round)  # [B, N]
+    return int(np.sum(np.max(cur, axis=-1) - 1))
+
+
+def fleet_commits(st) -> int:
+    return int(np.sum(jax.device_get(st.ctx.commit_count)))
+
+
+def run_bench(n_nodes: int, batch: int, chunk: int = 128, reps: int = 4,
+              delay_kind: str = "uniform", drop: float = 0.0):
+    """One compiled ``chunk``-step scan, reused: 1 warmup call + ``reps``
+    timed calls (a single XLA program keeps compile time bounded)."""
+    p = SimParams(
+        n_nodes=n_nodes,
+        delay_kind=delay_kind,
+        drop_prob=drop,
+        max_clock=2**30,  # never halt inside the timed window
+        queue_cap=max(32, 4 * n_nodes),
+    )
+    seeds = np.arange(batch, dtype=np.uint32)
+    st = S.init_batch(p, seeds)
+    st = S.dedupe_buffers(st)
+    run = S.make_run_fn(p, chunk)
+    st = run(st)  # compile + reach steady state
+    jax.block_until_ready(st)
+    r0, c0 = fleet_rounds(st), fleet_commits(st)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st = run(st)
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+    r1, c1 = fleet_rounds(st), fleet_commits(st)
+    return {
+        "rounds_per_sec": (r1 - r0) / dt,
+        "commits_per_sec": (c1 - c0) / dt,
+        "events_per_sec": batch * chunk * reps / dt,
+        "elapsed_s": dt,
+        "instances": batch,
+        "n_nodes": n_nodes,
+        "steps": chunk * reps,
+    }
+
+
+def main():
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    batch = int(os.environ.get("BENCH_B", 32768 if on_tpu else 2048))
+    chunk = int(os.environ.get("BENCH_STEPS", 128 if on_tpu else 64))
+    reps = int(os.environ.get("BENCH_REPS", 4 if on_tpu else 2))
+    n_nodes = int(os.environ.get("BENCH_NODES", 4))
+    res = run_bench(n_nodes, batch, chunk, reps)
+    out = {
+        "metric": "rounds_per_sec",
+        "value": round(res["rounds_per_sec"], 1),
+        "unit": "rounds/sec",
+        "vs_baseline": round(res["rounds_per_sec"] / 1e6, 4),
+        "commits_per_sec": round(res["commits_per_sec"], 1),
+        "events_per_sec": round(res["events_per_sec"], 1),
+        "instances": res["instances"],
+        "n_nodes": n_nodes,
+        "platform": platform,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

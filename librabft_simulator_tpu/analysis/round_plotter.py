@@ -1,0 +1,98 @@
+"""Round-switch plot, capability analog of
+/root/reference/bft-lib/src/visualization/round_switch/round_plotter.py.
+
+Reads the ``round_switches.txt`` CSV written by
+:class:`~librabft_simulator_tpu.analysis.data_writer.DataWriter` and renders
+each node's round number over global time.  matplotlib is optional: without it
+(or with ``--ascii``) an ASCII step plot is printed instead, so the tool works
+in headless/TPU pods.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+
+
+def read_csv(csv_path):
+    with open(csv_path) as f:
+        return list(csv.reader(f))
+
+
+def step_series(csv_data):
+    """Per-node list of (time, round) switch points, ascending time."""
+    n = len(csv_data[0])
+    series = []
+    for node in range(n):
+        pts = []
+        for r, row in enumerate(csv_data[1:]):
+            cell = row[node] if node < len(row) else ""
+            if cell != "":
+                pts.append((int(cell), r))
+        series.append(sorted(pts))
+    return series
+
+
+def plot_matplotlib(series, out=None):
+    import matplotlib
+
+    matplotlib.use("Agg" if out else matplotlib.get_backend())
+    import matplotlib.pyplot as plt
+
+    plt.figure()
+    for node, pts in enumerate(series):
+        if not pts:
+            continue
+        xs = [t for t, _ in pts]
+        ys = [r for _, r in pts]
+        plt.step(xs, ys, where="post", label=f"Node: {node}")
+    plt.legend()
+    plt.xlabel("Time")
+    plt.ylabel("Round number")
+    plt.grid(axis="both", which="both")
+    if out:
+        plt.savefig(out, dpi=120)
+        print(f"wrote {out}")
+    else:
+        plt.show()
+
+
+def plot_ascii(series, width=72, height=18, file=None):
+    file = file or sys.stdout
+    pts_all = [pt for pts in series for pt in pts]
+    if not pts_all:
+        print("(no round switches recorded)", file=file)
+        return
+    tmax = max(t for t, _ in pts_all) or 1
+    rmax = max(r for _, r in pts_all) or 1
+    grid = [[" "] * width for _ in range(height)]
+    for node, pts in enumerate(series):
+        ch = str(node % 10)
+        for t, r in pts:
+            x = min(int(t / tmax * (width - 1)), width - 1)
+            y = min(int(r / rmax * (height - 1)), height - 1)
+            grid[height - 1 - y][x] = ch
+    print(f"round 0..{rmax} (y) vs time 0..{tmax} (x); digit = node id", file=file)
+    for row in grid:
+        print("".join(row), file=file)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("csv_path", help="round_switches.txt from DataWriter")
+    ap.add_argument("--out", help="save PNG instead of showing")
+    ap.add_argument("--ascii", action="store_true", help="force ASCII output")
+    args = ap.parse_args(argv)
+    series = step_series(read_csv(args.csv_path))
+    if args.ascii:
+        plot_ascii(series)
+        return
+    try:
+        plot_matplotlib(series, args.out)
+    except ImportError:
+        plot_ascii(series)
+
+
+if __name__ == "__main__":
+    main()

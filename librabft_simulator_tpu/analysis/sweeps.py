@@ -1,0 +1,119 @@
+"""Parameter sweeps over the BASELINE.json benchmark configs.
+
+Config 1: LibraBFTv2, 3 nodes, 1 instance, default (lognormal) delays.
+Config 2: 4 nodes, 10k instances, uniform delay.
+Config 3: 64 nodes, 1k instances, Pareto delay + 5% drop.
+Config 4: f equivocating authors swept over f in [0, n/3], 10k instances.
+Config 5: two-chain HotStuff variant, 16 nodes, 10k instances.
+
+Each sweep returns/records JSON-serializable dicts; the CLI entry point is
+``python -m librabft_simulator_tpu.analysis.sweeps``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from ..core.types import SimParams
+from ..sim import byzantine as B
+from ..sim import simulator as S
+
+
+def _fleet_stats(p: SimParams, st, elapsed: float) -> dict:
+    g = lambda x: np.asarray(jax.device_get(x))  # noqa: E731
+    cc = g(st.ctx.commit_count)
+    cur = g(st.store.current_round)
+    if cc.ndim == 1:  # unbatched
+        cc = cc[None]
+        cur = cur[None]
+    rounds = (cur.max(axis=-1) - 1).sum()
+    return {
+        "instances": int(cc.shape[0]),
+        "n_nodes": p.n_nodes,
+        "total_commits": int(cc.sum()),
+        "mean_commits_per_node": float(cc.mean()),
+        "min_commits": int(cc.min()),
+        "total_rounds": int(rounds),
+        "elapsed_s": round(elapsed, 3),
+        "rounds_per_sec": round(float(rounds) / elapsed, 1) if elapsed else None,
+        "msgs_sent": int(g(st.n_msgs_sent).sum()),
+        "msgs_dropped": int(g(st.n_msgs_dropped).sum()),
+        "queue_full": int(g(st.n_queue_full).sum()),
+        "sync_jumps": int(g(st.ctx.sync_jumps).sum()),
+    }
+
+
+def run_config(p: SimParams, n_instances: int, seed0: int = 0,
+               f: int = 0, byz_kind: str = "equivocate") -> dict:
+    seeds = np.arange(seed0, seed0 + n_instances, dtype=np.uint32)
+    if f > 0:
+        st = B.init_fault_batch(p, seeds, f, byz_kind)
+    else:
+        st = S.init_batch(p, seeds)
+    t0 = time.perf_counter()
+    st = S.run_to_completion(p, st, batched=True)
+    elapsed = time.perf_counter() - t0
+    out = _fleet_stats(p, st, elapsed)
+    if f > 0:
+        honest = np.arange(p.n_nodes) >= f
+        out["f"] = f
+        out["byz_kind"] = byz_kind
+        out["safe_fraction"] = float(B.check_safety(st, honest).mean())
+    return out
+
+
+def baseline_configs(scale: float = 1.0) -> dict:
+    """The five BASELINE.json configs; ``scale`` shrinks instance counts for
+    quick runs (scale=1.0 reproduces the stated sizes)."""
+    k = lambda n: max(int(n * scale), 1)  # noqa: E731
+    return {
+        "1_default_3node": (SimParams(n_nodes=3, max_clock=1000), k(1), 0),
+        "2_uniform_4node_10k": (
+            SimParams(n_nodes=4, max_clock=1000, delay_kind="uniform"), k(10000), 0),
+        "3_pareto_drop_64node_1k": (
+            SimParams(n_nodes=64, max_clock=1000, delay_kind="pareto",
+                      drop_prob=0.05, queue_cap=1024), k(1000), 0),
+        "4_byzantine_sweep_10k": (
+            SimParams(n_nodes=4, max_clock=1000), k(10000), "sweep"),
+        "5_hotstuff2_16node_10k": (
+            SimParams(n_nodes=16, max_clock=1000, commit_chain=2, queue_cap=256),
+            k(10000), 0),
+    }
+
+
+def run_all(scale: float = 1.0, out_path: str | None = None) -> dict:
+    results = {}
+    for name, (p, n, f_mode) in baseline_configs(scale).items():
+        if f_mode == "sweep":
+            results[name] = [
+                dataclasses.asdict(r)
+                for r in B.f_sweep(p, n, f_values=list(range(p.n_nodes // 3 + 1)))
+            ]
+        else:
+            results[name] = run_config(p, n)
+        print(f"[sweep] {name}: done", file=sys.stderr)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scale", type=float, default=0.01,
+                    help="instance-count scale factor (1.0 = full BASELINE sizes)")
+    ap.add_argument("--out", default=None, help="write JSON to this path")
+    args = ap.parse_args(argv)
+    results = run_all(args.scale, args.out)
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

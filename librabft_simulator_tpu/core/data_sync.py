@@ -167,7 +167,7 @@ def handle_request(p: SimParams, s: Store, author, req: Payload) -> Payload:
     """data_sync.rs:183-207 with the K-tail redesign of unknown_records."""
     resp = create_notification(p, s, author)
     # Walk back K QCs from our highest QC; emit ascending (blocks + QCs).
-    valids, rounds, vars_ = store_ops.qc_walk_back(
+    valids, rounds, vars_, _ = store_ops.qc_walk_back(
         p, s, s.hqc_round > 0, s.hqc_round, s.hqc_var, p.chain_k
     )
     valids, rounds, vars_ = valids[::-1], rounds[::-1], vars_[::-1]
@@ -247,4 +247,5 @@ def _anchored_store(p: SimParams, s: Store, pay: Payload) -> Store:
         hqc_round=base_qc.round,   # 'no QC beyond the anchor yet'
         htc_round=base_qc.round,
         hcr=base_qc.round,
+        anchored=jnp.bool_(True),
     )

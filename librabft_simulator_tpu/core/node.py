@@ -201,8 +201,12 @@ def update_tracker(p: SimParams, nx: NodeExtra, s: Store, clock):
         tracker_hcr=jnp.where(bump, s.hcr, nx.tracker_hcr),
         tracker_commit_time=jnp.where(bump, _i32(clock), nx.tracker_commit_time),
     )
-    deadline = jnp.maximum(nx.tracker_commit_time, nx.latest_query_all) \
-        + p.target_commit_interval
+    base = jnp.maximum(nx.tracker_commit_time, nx.latest_query_all)
+    # Saturating add (see pacemaker.update_pacemaker): base can approach NEVER.
+    deadline = base + jnp.minimum(_i32(p.target_commit_interval), _i32(NEVER) - base)
     should_query_all = clock >= deadline
-    deadline = jnp.where(should_query_all, clock + p.target_commit_interval, deadline)
+    deadline = jnp.where(
+        should_query_all,
+        clock + jnp.minimum(_i32(p.target_commit_interval), _i32(NEVER) - clock),
+        deadline)
     return nx, should_query_all, deadline

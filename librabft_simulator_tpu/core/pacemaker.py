@@ -84,7 +84,12 @@ def update_pacemaker(
     next_sched = jnp.where(should_propose, _i32(clock), next_sched)
 
     has_to = store_ops.has_timeout(s, author, pm2.active_round)
-    timeout_deadline = pm2.round_start + pm2.round_duration
+    # a + min(b, NEVER - a) == min(a + b, NEVER) without int32 wraparound —
+    # round durations reach ~2^30 (delta * n^gamma, table-capped at NEVER//2),
+    # so plain adds overflow once a node stalls long enough.  The oracle and
+    # the C++ engine compute the same saturating sums in wide integers.
+    timeout_deadline = pm2.round_start + jnp.minimum(
+        pm2.round_duration, _i32(NEVER) - pm2.round_start)
     past_deadline = clock >= timeout_deadline
     should_create_timeout = ~has_to & past_deadline
     should_broadcast = should_broadcast | should_create_timeout
@@ -92,10 +97,16 @@ def update_pacemaker(
         ~has_to & ~past_deadline, jnp.minimum(next_sched, timeout_deadline), next_sched
     )
     # Once we hold a timeout, enforce periodic query-all (pacemaker.rs:195-204).
-    period = (_i32(p.lam_fp) * pm2.round_duration) >> 16
-    qad = latest_query_all + period
+    # floor(lam_fp * d / 2^16) decomposed as hi*lam_fp + (lo*lam_fp >> 16)
+    # (exact for lam <= 1) — the direct 32-bit product would wrap.
+    d_hi, d_lo = pm2.round_duration >> 16, pm2.round_duration & 0xFFFF
+    # Low-part product can reach 2^32 (lam == 1): keep it in uint32.
+    lo_term = ((d_lo.astype(jnp.uint32) * jnp.uint32(p.lam_fp)) >> 16).astype(I32)
+    period = d_hi * _i32(p.lam_fp) + lo_term
+    qad = latest_query_all + jnp.minimum(period, _i32(NEVER) - latest_query_all)
     should_query_all = has_to & (clock >= qad)
-    qad = jnp.where(should_query_all, clock + period, qad)
+    qad = jnp.where(should_query_all,
+                    clock + jnp.minimum(period, _i32(NEVER) - clock), qad)
     next_sched = jnp.where(has_to, jnp.minimum(next_sched, qad), next_sched)
 
     actions = PacemakerActions(

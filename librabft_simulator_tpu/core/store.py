@@ -118,16 +118,17 @@ def qc_walk_back(p: SimParams, s: Store, start_valid, start_round, start_var, st
 
     def body(carry, _):
         alive, r, v = carry
-        emit = (alive, r, v)
         bvar = _qc_blk_var(p, s, r, v)
         found, pr, pv = prev_qc_of_block(p, s, r, bvar)
-        alive2 = alive & found & (pv >= 0)  # pv < 0 => reached the initial QC
+        hit_initial = alive & found & (pv < 0)  # chains directly to initial QC
+        emit = (alive, r, v, hit_initial)
+        alive2 = alive & found & (pv >= 0)
         return (alive2, jnp.where(alive2, pr, r), jnp.where(alive2, pv, v)), emit
 
     init = (jnp.asarray(start_valid) & (start_round > s.initial_round),
             _i32(start_round), _i32(start_var))
-    _, (valids, rounds, vars_) = jax.lax.scan(body, init, None, length=steps)
-    return valids, rounds, vars_
+    _, (valids, rounds, vars_, hits) = jax.lax.scan(body, init, None, length=steps)
+    return valids, rounds, vars_, hits
 
 
 # ---------------------------------------------------------------------------
@@ -151,14 +152,19 @@ def second_previous_round(p: SimParams, s: Store, blk_round, blk_var):
 
 
 def vote_committed_state(p: SimParams, s: Store, blk_round, blk_var):
-    """(valid, depth, tag) of the state that the commit rule would finalize if
-    a QC formed on this block (record_store.rs:237-255), generalized to
-    ``commit_chain`` C: the C-1 QCs below the block must have contiguous
-    rounds; the oldest one's state is committed."""
+    """(valid, depth, tag, undeterminable) of the state the commit rule would
+    finalize if a QC formed on this block (record_store.rs:237-255),
+    generalized to ``commit_chain`` C: the C-1 QCs below the block must have
+    contiguous rounds; the oldest one's state is committed.
+
+    ``undeterminable`` is True when the store is *anchored* (state-sync jump,
+    data_sync.py) and the walk touched the synthetic anchor QC, whose history
+    is unknown — the receiver must then trust the (signature-backed) commit
+    fields of the incoming record rather than recompute them."""
     C = p.commit_chain
     r_top = _i32(blk_round)
     found0, pr, pv = prev_qc_of_block(p, s, blk_round, blk_var)
-    valids, rounds, vars_ = qc_walk_back(
+    valids, rounds, vars_, hits = qc_walk_back(
         p, s, found0 & (pv >= 0), pr, jnp.maximum(pv, 0), C - 1
     )
     ok = jnp.bool_(True)
@@ -166,10 +172,12 @@ def vote_committed_state(p: SimParams, s: Store, blk_round, blk_var):
     for i in range(C - 1):
         ok = ok & valids[i] & (prev_r == rounds[i] + 1)
         prev_r = rounds[i]
+    touched = (found0 & (pv < 0)) | jnp.any(hits[: C - 1])
+    undet = s.anchored & touched
     d, t = _qc_state(p, s, rounds[C - 2], vars_[C - 2])
     zero_d = _i32(0)
     zero_t = jnp.zeros((), U32)
-    return ok, jnp.where(ok, d, zero_d), jnp.where(ok, t, zero_t)
+    return ok, jnp.where(ok, d, zero_d), jnp.where(ok, t, zero_t), undet
 
 
 def compute_state(p: SimParams, s: Store, blk_round, blk_var):
@@ -194,7 +202,7 @@ def update_commit_chain(p: SimParams, s: Store, qc_round, qc_var) -> Store:
     """The 3-chain (or C-chain) commit rule applied after inserting the QC at
     (qc_round, qc_var) (record_store.rs:221-235)."""
     C = p.commit_chain
-    valids, rounds, _ = qc_walk_back(p, s, True, qc_round, qc_var, C)
+    valids, rounds, _, _ = qc_walk_back(p, s, True, qc_round, qc_var, C)
     ok = jnp.bool_(True)
     for i in range(C):
         ok = ok & valids[i]
@@ -330,9 +338,11 @@ def insert_block(p: SimParams, s: Store, weights, b: BlockMsg, rec_epoch):
 def insert_vote(p: SimParams, s: Store, weights, v: VoteMsg):
     """record_store.rs:292-329 (verify) + :477-499 (insert + ballot)."""
     bvar = blk_find(p, s, v.round, v.blk_tag)
-    cs_ok, cs_d, cs_t = vote_committed_state(p, s, v.round, jnp.maximum(bvar, 0))
-    commit_match = (v.commit_valid == cs_ok) & (
-        ~cs_ok | ((v.commit_depth == cs_d) & (v.commit_tag == cs_t))
+    cs_ok, cs_d, cs_t, cs_undet = vote_committed_state(
+        p, s, v.round, jnp.maximum(bvar, 0))
+    commit_match = cs_undet | (
+        (v.commit_valid == cs_ok)
+        & (~cs_ok | ((v.commit_depth == cs_d) & (v.commit_tag == cs_t)))
     )
     author = jnp.clip(v.author, 0, p.n_nodes - 1)
     ok = (
@@ -400,9 +410,10 @@ def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
     bvar = blk_find(p, s, q.round, q.blk_tag)
     bvar_c = jnp.maximum(bvar, 0)
     author_ok = s.blk_author[sl, bvar_c] == q.author
-    cs_ok, cs_d, cs_t = vote_committed_state(p, s, q.round, bvar_c)
-    commit_match = (q.commit_valid == cs_ok) & (
-        ~cs_ok | ((q.commit_depth == cs_d) & (q.commit_tag == cs_t))
+    cs_ok, cs_d, cs_t, cs_undet = vote_committed_state(p, s, q.round, bvar_c)
+    commit_match = cs_undet | (
+        (q.commit_valid == cs_ok)
+        & (~cs_ok | ((q.commit_depth == cs_d) & (q.commit_tag == cs_t)))
     )
     exec_ok, st_d, st_t = compute_state(p, s, q.round, bvar_c)
     state_match = exec_ok & (st_d == q.state_depth) & (st_t == q.state_tag)
@@ -496,7 +507,7 @@ def create_vote(p: SimParams, s: Store, weights, author, blk_round, blk_var):
     """record_store.rs:676-700: execute the block, vote for the resulting
     state.  Returns (store, ok) — ok False if execution failed."""
     sl = _slot(p, blk_round)
-    cs_ok, cs_d, cs_t = vote_committed_state(p, s, blk_round, blk_var)
+    cs_ok, cs_d, cs_t, _ = vote_committed_state(p, s, blk_round, blk_var)
     exec_ok, st_d, st_t = compute_state(p, s, blk_round, blk_var)
     v = VoteMsg(
         valid=exec_ok, epoch=s.epoch_id, round=_i32(blk_round),
@@ -528,7 +539,7 @@ def check_new_qc(p: SimParams, s: Store, weights, author):
     trigger = won & (blk_author == _i32(author))
     st_d = s.bal_state_depth[bvar, s.won_slot]
     st_t = s.bal_state_tag[bvar, s.won_slot]
-    cs_ok, cs_d, cs_t = vote_committed_state(p, s, s.current_round, bvar)
+    cs_ok, cs_d, cs_t, _ = vote_committed_state(p, s, s.current_round, bvar)
     votes_mask = s.vt_valid & (s.vt_state_depth == st_d) & (s.vt_state_tag == st_t) \
         & (s.vt_blk_var == bvar)
     lo, hi = author_mask_words(votes_mask)
@@ -557,7 +568,7 @@ def committed_states_after(p: SimParams, s: Store, after_round):
     ASCENDING round order (valid entries are right-aligned)."""
     W = p.window
     start_r = jnp.where(s.hcc_valid, s.hcc_round, _i32(0))
-    valids, rounds, vars_ = qc_walk_back(p, s, s.hcc_valid, start_r, s.hcc_var, W)
+    valids, rounds, vars_, _ = qc_walk_back(p, s, s.hcc_valid, start_r, s.hcc_var, W)
     skip = p.commit_chain - 1
     idx = jnp.arange(W)
     keep = valids & (idx >= skip) & (rounds > _i32(after_round))

@@ -78,6 +78,7 @@ class SimParams:
     drop_prob: float = 0.0
     max_clock: int = 1000
     dur_table_size: int = 64
+    trace_cap: int = 0        # round-switch trace entries (0 = tracing off)
 
     @property
     def lam_fp(self) -> int:
@@ -327,6 +328,8 @@ class Store:
     hcc_valid: Array           # bool
     hcc_round: Array
     hcc_var: Array
+    anchored: Array            # bool: initial QC is a state-sync jump anchor
+                               # with unknown history (see store.vote_committed_state)
 
     @classmethod
     def initial(cls, p: SimParams, shape=()):
@@ -366,6 +369,7 @@ class Store:
             hqc_round=_zeros(shape), hqc_var=_zeros(shape), htc_round=_zeros(shape),
             hcr=_zeros(shape), hcc_valid=_zeros(shape, jnp.bool_),
             hcc_round=_zeros(shape), hcc_var=_zeros(shape),
+            anchored=_zeros(shape, jnp.bool_),
         )
 
 
@@ -484,3 +488,10 @@ class SimState:
     n_msgs_sent: Array
     n_msgs_dropped: Array
     n_queue_full: Array
+    # Round-switch trace ring (DataWriter capability,
+    # /root/reference/bft-lib/src/data_writer.rs:34-49): entry = (node, round,
+    # global time) appended whenever a node enters a higher pacemaker round.
+    trace_node: Array   # [T]
+    trace_round: Array  # [T]
+    trace_time: Array   # [T]
+    trace_count: Array

@@ -1,0 +1,147 @@
+"""Simulator CLI, mirroring the reference binary
+(/root/reference/librabft-v2/src/main.rs): one (or many) LibraBFTv2
+simulations with configurable network/protocol parameters.
+
+    python -m librabft_simulator_tpu.main --nodes 3 --max_clock 1000
+    python -m librabft_simulator_tpu.main --instances 10000 --nodes 4 \
+        --delay uniform --output_data_files /tmp/out
+
+Beyond the reference CLI, ``--instances`` runs a whole batched fleet (the TPU
+point of the rebuild) and ``--commit_chain 2`` switches to the two-chain
+HotStuff-style rule (BASELINE config #5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+import jax
+import numpy as np
+
+from .core.types import SimParams
+from .sim import byzantine as B
+from .sim import simulator as S
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(
+        prog="librabft_simulator_tpu",
+        description="A monte-carlo simulation of the LibraBFT consensus protocol "
+                    "(TPU-native batched rebuild)")
+    ap.add_argument("--max_clock", type=int, default=1000,
+                    help="Time at which to stop the simulation")
+    ap.add_argument("--mean", type=float, default=10.0,
+                    help="Mean of the network delay distribution")
+    ap.add_argument("--variance", type=float, default=4.0,
+                    help="Variance of the network delay distribution")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="Seed for the randomness in the simulation")
+    ap.add_argument("--nodes", type=int, default=3, help="Number of nodes")
+    ap.add_argument("--commands_per_epoch", type=int, default=30000,
+                    help="Commands per epoch (epoch switch trigger)")
+    ap.add_argument("--target_commit_interval", type=int, default=100000)
+    ap.add_argument("--delta", type=int, default=20,
+                    help="Base duration of rounds")
+    ap.add_argument("--gamma", type=float, default=2.0,
+                    help="Exponent in round duration delta * n^gamma")
+    ap.add_argument("--lambda", dest="lam", type=float, default=0.5,
+                    help="Query-all period as a fraction of round duration")
+    ap.add_argument("--output_data_files", default=None,
+                    help="Directory for round-switch CSV + message counts")
+    # TPU-rebuild extensions.
+    ap.add_argument("--instances", type=int, default=1,
+                    help="Number of independent simulations run as one batch")
+    ap.add_argument("--delay", default="lognormal",
+                    choices=["lognormal", "uniform", "pareto", "constant"])
+    ap.add_argument("--drop_prob", type=float, default=0.0)
+    ap.add_argument("--commit_chain", type=int, default=3,
+                    help="3 = LibraBFTv2 3-chain, 2 = HotStuff-style 2-chain")
+    ap.add_argument("--byzantine_f", type=int, default=0,
+                    help="Number of faulty authors (0..n/3)")
+    ap.add_argument("--byzantine_kind", default="equivocate",
+                    choices=["equivocate", "silent"])
+    ap.add_argument("--json", action="store_true", help="JSON summary to stdout")
+    ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                    help="force a JAX backend (some TPU plugins ignore "
+                         "JAX_PLATFORMS; this flag always wins)")
+    ap.add_argument("--no_compile_cache", action="store_true",
+                    help="disable the persistent XLA compilation cache")
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if not args.no_compile_cache:
+        # The jitted step is a large graph (~minutes of XLA time per new
+        # static config); cache compilations across runs.
+        os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    seed = args.seed if args.seed is not None else random.getrandbits(32)
+    print(f"seed: {seed}", file=sys.stderr)
+    trace = 4096 if args.output_data_files else 0
+    p = SimParams(
+        n_nodes=args.nodes,
+        max_clock=args.max_clock,
+        delay_kind=args.delay,
+        delay_mean=args.mean,
+        delay_variance=args.variance,
+        drop_prob=args.drop_prob,
+        commands_per_epoch=args.commands_per_epoch,
+        target_commit_interval=args.target_commit_interval,
+        delta=args.delta,
+        gamma=args.gamma,
+        lam=args.lam,
+        commit_chain=args.commit_chain,
+        # In-flight messages scale ~n^2 (each update may broadcast to n-1
+        # peers); 16n keeps 16-64-node fleets live (smaller caps starve them).
+        queue_cap=max(32, 16 * args.nodes),
+        trace_cap=trace,
+    )
+    seeds = (np.uint32(seed) + np.arange(args.instances, dtype=np.uint32))
+    t0 = time.perf_counter()
+    if args.byzantine_f > 0:
+        st = B.init_fault_batch(p, seeds, args.byzantine_f, args.byzantine_kind)
+    else:
+        st = S.init_batch(p, seeds)
+    st = S.run_to_completion(p, st, batched=True)
+    elapsed = time.perf_counter() - t0
+
+    cc = np.asarray(jax.device_get(st.ctx.commit_count))
+    print(f"Commands executed per node: {cc.tolist() if args.instances == 1 else cc.mean(axis=0).tolist()}",
+          file=sys.stderr)
+    summary = {
+        "seed": int(seed),
+        "instances": args.instances,
+        "nodes": args.nodes,
+        "elapsed_s": round(elapsed, 3),
+        "mean_commits_per_node": float(cc.mean()),
+        "total_events": int(np.asarray(jax.device_get(st.n_events)).sum()),
+        "msgs_sent": int(np.asarray(jax.device_get(st.n_msgs_sent)).sum()),
+        "msgs_dropped": int(np.asarray(jax.device_get(st.n_msgs_dropped)).sum()),
+    }
+    if args.byzantine_f > 0:
+        honest = np.arange(p.n_nodes) >= args.byzantine_f
+        summary["safe_fraction"] = float(B.check_safety(st, honest).mean())
+    if args.output_data_files:
+        from .analysis.data_writer import DataWriter
+
+        DataWriter(p, args.output_data_files).write(st, instance=0)
+        print(f"wrote data files to {args.output_data_files}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        for k, v in summary.items():
+            print(f"{k}: {v}", file=sys.stderr)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""ctypes bindings for the native C++ engine (native/engine.cpp).
+
+Builds ``libbft_engine.so`` on demand with g++ (cached next to the source) and
+exposes :func:`run` returning the same observables as the oracle/JAX paths —
+parity-checked in tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from .core.types import SimParams
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "engine.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libbft_engine.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(force: bool = False) -> str:
+    """Compile the shared library if missing or stale."""
+    if (not force and os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+        return _LIB
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC],
+        check=True,
+    )
+    return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build())
+        lib.bft_run.restype = ctypes.c_int
+        lib.bft_run.argtypes = (
+            [ctypes.c_int] * 11
+            + [ctypes.c_uint32, ctypes.c_uint32, ctypes.c_longlong]
+            + [
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),   # delay
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),   # dur
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),   # weights
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),   # eq
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),   # silent
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),   # global
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),   # node
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),   # log
+            ]
+        )
+        _lib = lib
+    return _lib
+
+
+class NativeResult:
+    def __init__(self, p: SimParams, halted, glob, node, log):
+        self.p = p
+        self.halted = bool(halted)
+        (self.n_events, self.clock, self.stamp_ctr, self.n_msgs_sent,
+         self.n_msgs_dropped, self.n_queue_full) = (int(x) for x in glob)
+        self.node = node.reshape(p.n_nodes, 7)
+        self.log = log.reshape(p.n_nodes, p.commit_log, 3)
+
+    def commit_count(self, a):
+        return int(self.node[a, 0])
+
+    def last_depth(self, a):
+        return int(self.node[a, 1])
+
+    def last_tag(self, a):
+        return int(self.node[a, 2])
+
+    def current_round(self, a):
+        return int(self.node[a, 3])
+
+    def hqc_round(self, a):
+        return int(self.node[a, 4])
+
+    def hcr(self, a):
+        return int(self.node[a, 5])
+
+    def committed_chain(self, a):
+        cc = self.commit_count(a)
+        H = self.p.commit_log
+        out = []
+        for i in range(max(cc - H, 0), cc):
+            pos = i % H
+            out.append((int(self.log[a, pos, 1]), int(self.log[a, pos, 2])))
+        return out
+
+
+def run(p: SimParams, seed: int, weights=None, byz_equivocate=None,
+        byz_silent=None, max_events: int = 10_000_000) -> NativeResult:
+    lib = _load()
+    n = p.n_nodes
+    delay = np.ascontiguousarray(p.delay_table(), np.int32)
+    dur = np.ascontiguousarray(p.duration_table(), np.int32)
+    w = np.ascontiguousarray(
+        weights if weights is not None else np.ones(n), np.int32)
+    eq = np.ascontiguousarray(
+        byz_equivocate if byz_equivocate is not None else np.zeros(n), np.uint8)
+    silent = np.ascontiguousarray(
+        byz_silent if byz_silent is not None else np.zeros(n), np.uint8)
+    glob = np.zeros(6, np.int64)
+    node = np.zeros(n * 7, np.int64)
+    log = np.zeros(n * p.commit_log * 3, np.int64)
+    halted = lib.bft_run(
+        p.n_nodes, p.window, p.queue_cap, p.chain_k, p.commit_log,
+        p.commands_per_epoch, p.target_commit_interval, p.lam_fp,
+        p.commit_chain, p.max_clock, p.dur_table_size,
+        ctypes.c_uint32(p.drop_u32), ctypes.c_uint32(seed & 0xFFFFFFFF),
+        ctypes.c_longlong(max_events),
+        delay, dur, w, eq, silent, glob, node, log,
+    )
+    return NativeResult(p, halted, glob, node, log)

@@ -1,0 +1,562 @@
+"""Pure-Python oracle: an independent interpreter of the exact integer
+semantics of the tensorized simulator.
+
+Used by ``tests/test_parity.py`` to check that the jitted JAX path produces
+bit-identical trajectories (the north-star "commit sequences byte-identical to
+the CPU simulator", BASELINE.json).  Everything is plain Python ints masked to
+32 bits — no numpy in the hot loop, no JAX.
+
+The oracle deliberately models the *same windowed-table design* as the tensor
+path (round-windowed [W, V] record tables, fixed-capacity queue, single timer
+slot per node): the window is part of the protocol-variant semantics (records
+outside it are rejected), so parity requires modeling it.  Reference
+counterparts are cited in the tensor modules; this file cites those.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..core.types import (
+    ELECTION_CLOSED,
+    ELECTION_ONGOING,
+    ELECTION_WON,
+    KIND_NOTIFY,
+    KIND_REQUEST,
+    KIND_RESPONSE,
+    KIND_TIMER,
+    SimParams,
+)
+from ..sim.simulator import EQUIV_SALT
+from ..utils.quantile import TABLE_BITS
+
+M32 = 0xFFFFFFFF
+NEVER = 2**31 - 1
+
+# -- hashing (mirrors utils/hashing.py) -------------------------------------
+
+TAG_BLOCK = 0x9E3779B1
+TAG_VOTE = 0x85EBCA77
+TAG_QC = 0xC2B2AE3D
+TAG_TIMEOUT = 0x27D4EB2F
+TAG_STATE = 0x165667B1
+TAG_EPOCH = 0x5851F42D
+TAG_LEADER = 0x2545F491
+TAG_SEED = 0x9E447687
+
+
+def mix32(h: int, x: int) -> int:
+    h = (h ^ (x & M32)) & M32
+    h = (h * 0x9E3779B1) & M32
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & M32
+    h ^= h >> 16
+    return h
+
+
+def fold(*words: int) -> int:
+    h = 0x811C9DC5
+    for w in words:
+        h = mix32(h, w)
+    return h
+
+
+def rng_u32(seed: int, counter: int) -> int:
+    return fold(TAG_SEED, seed, counter)
+
+
+def state_tag_next(prev_tag, cmd_proposer, cmd_index, time):
+    return fold(TAG_STATE, prev_tag, cmd_proposer & M32, cmd_index & M32, time & M32)
+
+
+def epoch_initial_tag(epoch_id: int) -> int:
+    return fold(TAG_EPOCH, epoch_id & M32)
+
+
+def initial_state_tag() -> int:
+    return fold(TAG_STATE, 0)
+
+
+# -- configuration (mirrors core/config.py) ----------------------------------
+
+
+def quorum_threshold(weights) -> int:
+    return 2 * sum(weights) // 3 + 1
+
+
+def pick_author(weights, seed_u32: int) -> int:
+    target = (seed_u32 & M32) % sum(weights)
+    cum = 0
+    for i, w in enumerate(weights):
+        cum += w
+        if cum > target:
+            return i
+    return len(weights) - 1
+
+
+def leader_of_round(weights, round_: int) -> int:
+    return pick_author(weights, fold(TAG_LEADER, round_ & M32))
+
+
+# -- wire structs ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BlockMsg:
+    valid: bool = False
+    round: int = 0
+    author: int = 0
+    prev_round: int = 0
+    prev_tag: int = 0
+    time: int = 0
+    cmd_proposer: int = 0
+    cmd_index: int = 0
+    tag: int = 0
+
+
+@dataclasses.dataclass
+class QcMsg:
+    valid: bool = False
+    epoch: int = 0
+    round: int = 0
+    blk_tag: int = 0
+    state_depth: int = 0
+    state_tag: int = 0
+    commit_valid: bool = False
+    commit_depth: int = 0
+    commit_tag: int = 0
+    author: int = 0
+    tag: int = 0
+
+
+@dataclasses.dataclass
+class VoteMsg:
+    valid: bool = False
+    epoch: int = 0
+    round: int = 0
+    blk_tag: int = 0
+    state_depth: int = 0
+    state_tag: int = 0
+    commit_valid: bool = False
+    commit_depth: int = 0
+    commit_tag: int = 0
+    author: int = 0
+
+
+@dataclasses.dataclass
+class TimeoutsMsg:
+    round: int = 0
+    valid: List[bool] = dataclasses.field(default_factory=list)
+    hcbr: List[int] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def empty(cls, n):
+        return cls(0, [False] * n, [0] * n)
+
+
+@dataclasses.dataclass
+class Payload:
+    epoch: int = 0
+    hcc: QcMsg = dataclasses.field(default_factory=QcMsg)
+    hqc: QcMsg = dataclasses.field(default_factory=QcMsg)
+    hcc_blk: BlockMsg = dataclasses.field(default_factory=BlockMsg)
+    prop_blk: BlockMsg = dataclasses.field(default_factory=BlockMsg)
+    vote: VoteMsg = dataclasses.field(default_factory=VoteMsg)
+    tc_to: TimeoutsMsg = dataclasses.field(default_factory=lambda: TimeoutsMsg.empty(0))
+    cur_to: TimeoutsMsg = dataclasses.field(default_factory=lambda: TimeoutsMsg.empty(0))
+    chain_blk: List[BlockMsg] = dataclasses.field(default_factory=list)
+    chain_qc: List[QcMsg] = dataclasses.field(default_factory=list)
+    req_hqc_round: int = 0
+    req_hcr: int = 0
+
+    @classmethod
+    def empty(cls, n, k):
+        return cls(
+            tc_to=TimeoutsMsg.empty(n), cur_to=TimeoutsMsg.empty(n),
+            chain_blk=[BlockMsg() for _ in range(k)],
+            chain_qc=[QcMsg() for _ in range(k)],
+        )
+
+
+# -- record store (mirrors core/store.py) ------------------------------------
+
+
+class Store:
+    def __init__(self, p: SimParams):
+        self.p = p
+        W, V, N = p.window, p.variants, p.n_nodes
+        z = lambda: [[0] * V for _ in range(W)]  # noqa: E731
+        zb = lambda: [[False] * V for _ in range(W)]  # noqa: E731
+        self.blk_valid = zb(); self.blk_round = z(); self.blk_author = z()
+        self.blk_prev_round = z(); self.blk_prev_tag = z(); self.blk_time = z()
+        self.blk_cmd_proposer = z(); self.blk_cmd_index = z(); self.blk_tag = z()
+        self.qc_valid = zb(); self.qc_round = z(); self.qc_blk_var = z()
+        self.qc_state_depth = z(); self.qc_state_tag = z()
+        self.qc_commit_valid = zb(); self.qc_commit_depth = z()
+        self.qc_commit_tag = z(); self.qc_author = z(); self.qc_tag = z()
+        self.vt_valid = [False] * N; self.vt_blk_var = [0] * N
+        self.vt_state_depth = [0] * N; self.vt_state_tag = [0] * N
+        self.vt_commit_valid = [False] * N; self.vt_commit_depth = [0] * N
+        self.vt_commit_tag = [0] * N
+        self.bal_used = [[False, False] for _ in range(V)]
+        self.bal_weight = [[0, 0] for _ in range(V)]
+        self.bal_state_depth = [[0, 0] for _ in range(V)]
+        self.bal_state_tag = [[0, 0] for _ in range(V)]
+        self.to_valid = [False] * N; self.to_hcbr = [0] * N; self.to_weight = 0
+        self.tc_valid = [False] * N; self.tc_hcbr = [0] * N
+        self.epoch_id = 0
+        self.initial_round = 0
+        self.initial_tag = epoch_initial_tag(0)
+        self.initial_state_depth = 0
+        self.initial_state_tag = initial_state_tag()
+        self.current_round = 1
+        self.proposed_var = -1
+        self.election = ELECTION_ONGOING
+        self.won_var = 0
+        self.won_slot = 0
+        self.hqc_round = 0; self.hqc_var = 0; self.htc_round = 0
+        self.hcr = 0
+        self.hcc_valid = False; self.hcc_round = 0; self.hcc_var = 0
+        self.anchored = False
+
+    # -- lookups
+    def _slot(self, r):
+        return r % self.p.window
+
+    def blk_find(self, r, tag):
+        sl = self._slot(r)
+        for v in range(self.p.variants):
+            if self.blk_valid[sl][v] and self.blk_round[sl][v] == r \
+                    and self.blk_tag[sl][v] == tag:
+                return v
+        return -1
+
+    def qc_find(self, r, tag):
+        sl = self._slot(r)
+        for v in range(self.p.variants):
+            if self.qc_valid[sl][v] and self.qc_round[sl][v] == r \
+                    and self.qc_tag[sl][v] == tag:
+                return v
+        return -1
+
+    def hqc_ref(self):
+        if self.hqc_round > self.initial_round:
+            return self.hqc_round, self.qc_tag[self._slot(self.hqc_round)][self.hqc_var]
+        return self.hqc_round, self.initial_tag
+
+    def prev_qc_of_block(self, r, var):
+        sl = self._slot(r)
+        pr = self.blk_prev_round[sl][var]
+        pt = self.blk_prev_tag[sl][var]
+        if pr == self.initial_round and pt == self.initial_tag:
+            return True, pr, -1
+        v = self.qc_find(pr, pt)
+        return v >= 0, pr, v
+
+    def qc_walk_back(self, start_valid, start_round, start_var, steps):
+        """Per-hop (valid, round, var, hit_initial), newest first."""
+        out = []
+        alive = bool(start_valid) and start_round > self.initial_round
+        r, v = start_round, start_var
+        for _ in range(steps):
+            bvar = self.qc_blk_var[self._slot(r)][v]
+            found, pr, pv = self.prev_qc_of_block(r, bvar)
+            hit = alive and found and pv < 0
+            out.append((alive, r, v, hit))
+            alive2 = alive and found and pv >= 0
+            if alive2:
+                r, v = pr, pv
+            alive = alive2
+        return out
+
+    def previous_round(self, r, var):
+        return self.blk_prev_round[self._slot(r)][var]
+
+    def second_previous_round(self, r, var):
+        found, pr, pv = self.prev_qc_of_block(r, var)
+        if pv < 0 or not found:
+            return self.initial_round
+        bvar = self.qc_blk_var[self._slot(pr)][pv]
+        return self.blk_prev_round[self._slot(pr)][bvar]
+
+    def vote_committed_state(self, blk_round, blk_var):
+        C = self.p.commit_chain
+        found0, pr, pv = self.prev_qc_of_block(blk_round, blk_var)
+        hops = self.qc_walk_back(found0 and pv >= 0, pr, max(pv, 0), C - 1)
+        ok = True
+        prev_r = blk_round
+        for i in range(C - 1):
+            ok = ok and hops[i][0] and prev_r == hops[i][1] + 1
+            prev_r = hops[i][1]
+        touched = (found0 and pv < 0) or any(h[3] for h in hops[: C - 1])
+        undet = self.anchored and touched
+        last = hops[C - 2]
+        sl = self._slot(last[1])
+        d = self.qc_state_depth[sl][last[2]]
+        t = self.qc_state_tag[sl][last[2]]
+        return (ok, d if ok else 0, t if ok else 0, undet)
+
+    def compute_state(self, blk_round, blk_var):
+        found, pr, pv = self.prev_qc_of_block(blk_round, blk_var)
+        if pv < 0:
+            base_d, base_t = self.initial_state_depth, self.initial_state_tag
+        else:
+            sl = self._slot(pr)
+            base_d = self.qc_state_depth[sl][pv]
+            base_t = self.qc_state_tag[sl][pv]
+        sl = self._slot(blk_round)
+        tag = state_tag_next(
+            base_t, self.blk_cmd_proposer[sl][blk_var],
+            self.blk_cmd_index[sl][blk_var], self.blk_time[sl][blk_var],
+        )
+        return found, base_d + 1, tag
+
+    def update_commit_chain(self, qc_round, qc_var):
+        C = self.p.commit_chain
+        hops = self.qc_walk_back(True, qc_round, qc_var, C)
+        ok = True
+        for i in range(C):
+            ok = ok and hops[i][0]
+            if i > 0:
+                ok = ok and hops[i - 1][1] == hops[i][1] + 1
+        r1 = hops[C - 1][1]
+        ok = ok and r1 > self.hcr
+        if ok:
+            self.hcr = r1
+            self.hcc_valid = True
+            self.hcc_round = qc_round
+            self.hcc_var = qc_var
+
+    def update_current_round(self, r):
+        if r > self.current_round:
+            N, V = self.p.n_nodes, self.p.variants
+            self.current_round = r
+            self.proposed_var = -1
+            self.vt_valid = [False] * N
+            self.to_valid = [False] * N  # to_hcbr kept stale, like the tensor path
+            self.to_weight = 0
+            self.bal_used = [[False, False] for _ in range(V)]
+            self.bal_weight = [[0, 0] for _ in range(V)]
+            self.bal_state_depth = [[0, 0] for _ in range(V)]
+            self.bal_state_tag = [[0, 0] for _ in range(V)]
+            self.election = ELECTION_ONGOING
+            self.won_var = 0
+            self.won_slot = 0
+
+    def _pick_variant(self, valid_col, round_col, tag_col, r, tag):
+        stale0 = (not valid_col[0]) or round_col[0] != r
+        stale1 = (not valid_col[1]) or round_col[1] != r
+        dup0 = (not stale0) and tag_col[0] == tag
+        dup1 = (not stale1) and tag_col[1] == tag
+        is_dup = dup0 or dup1
+        var = 0 if stale0 else (1 if stale1 else -1)
+        return var, is_dup, var >= 0
+
+    # -- insertions
+    def insert_block(self, weights, b: BlockMsg, rec_epoch):
+        p = self.p
+        sl = self._slot(b.round)
+        var, is_dup, has_room = self._pick_variant(
+            self.blk_valid[sl], self.blk_round[sl], self.blk_tag[sl], b.round, b.tag)
+        prev_initial = b.prev_round == self.initial_round and b.prev_tag == self.initial_tag
+        prev_known = prev_initial or self.qc_find(b.prev_round, b.prev_tag) >= 0
+        in_window = b.round > self.current_round - p.window
+        ok = (b.valid and rec_epoch == self.epoch_id and not is_dup and has_room
+              and prev_known and b.round > b.prev_round and in_window)
+        if not ok:
+            return False
+        var = max(var, 0)
+        self.blk_valid[sl][var] = True
+        self.blk_round[sl][var] = b.round
+        self.blk_author[sl][var] = b.author
+        self.blk_prev_round[sl][var] = b.prev_round
+        self.blk_prev_tag[sl][var] = b.prev_tag
+        self.blk_time[sl][var] = b.time
+        self.blk_cmd_proposer[sl][var] = b.cmd_proposer
+        self.blk_cmd_index[sl][var] = b.cmd_index
+        self.blk_tag[sl][var] = b.tag
+        if b.round == self.current_round and \
+                leader_of_round(weights, self.current_round) == b.author:
+            self.proposed_var = var
+        return True
+
+    def insert_vote(self, weights, v: VoteMsg):
+        author = min(max(v.author, 0), self.p.n_nodes - 1)
+        bvar = self.blk_find(v.round, v.blk_tag)
+        cs_ok, cs_d, cs_t, cs_undet = self.vote_committed_state(v.round, max(bvar, 0))
+        commit_match = cs_undet or (
+            v.commit_valid == cs_ok
+            and (not cs_ok or (v.commit_depth == cs_d and v.commit_tag == cs_t)))
+        ok = (v.valid and v.epoch == self.epoch_id and bvar >= 0 and commit_match
+              and v.round == self.current_round and not self.vt_valid[author])
+        if not ok:
+            return False
+        bvar = max(bvar, 0)
+        self.vt_valid[author] = True
+        self.vt_blk_var[author] = bvar
+        self.vt_state_depth[author] = v.state_depth
+        self.vt_state_tag[author] = v.state_tag
+        self.vt_commit_valid[author] = v.commit_valid
+        self.vt_commit_depth[author] = v.commit_depth
+        self.vt_commit_tag[author] = v.commit_tag
+        if self.election != ELECTION_ONGOING:
+            return True
+        m0 = self.bal_used[bvar][0] and self.bal_state_depth[bvar][0] == v.state_depth \
+            and self.bal_state_tag[bvar][0] == v.state_tag
+        m1 = self.bal_used[bvar][1] and self.bal_state_depth[bvar][1] == v.state_depth \
+            and self.bal_state_tag[bvar][1] == v.state_tag
+        if m0:
+            slot = 0
+        elif m1:
+            slot = 1
+        elif not self.bal_used[bvar][0]:
+            slot = 0
+        elif not self.bal_used[bvar][1]:
+            slot = 1
+        else:
+            return True
+        self.bal_used[bvar][slot] = True
+        self.bal_weight[bvar][slot] += weights[author]
+        self.bal_state_depth[bvar][slot] = v.state_depth
+        self.bal_state_tag[bvar][slot] = v.state_tag
+        if self.bal_weight[bvar][slot] >= quorum_threshold(weights):
+            self.election = ELECTION_WON
+            self.won_var = bvar
+            self.won_slot = slot
+        return True
+
+    def insert_qc(self, weights, q: QcMsg):
+        p = self.p
+        sl = self._slot(q.round)
+        var, is_dup, has_room = self._pick_variant(
+            self.qc_valid[sl], self.qc_round[sl], self.qc_tag[sl], q.round, q.tag)
+        bvar = self.blk_find(q.round, q.blk_tag)
+        bvar_c = max(bvar, 0)
+        author_ok = self.blk_author[sl][bvar_c] == q.author
+        cs_ok, cs_d, cs_t, cs_undet = self.vote_committed_state(q.round, bvar_c)
+        commit_match = cs_undet or (
+            q.commit_valid == cs_ok
+            and (not cs_ok or (q.commit_depth == cs_d and q.commit_tag == cs_t)))
+        exec_ok, st_d, st_t = self.compute_state(q.round, bvar_c)
+        state_match = exec_ok and st_d == q.state_depth and st_t == q.state_tag
+        in_window = q.round > self.current_round - p.window
+        ok = (q.valid and q.epoch == self.epoch_id and not is_dup and has_room
+              and bvar >= 0 and author_ok and commit_match and state_match
+              and in_window)
+        if not ok:
+            return False
+        var = max(var, 0)
+        self.qc_valid[sl][var] = True
+        self.qc_round[sl][var] = q.round
+        self.qc_blk_var[sl][var] = bvar_c
+        self.qc_state_depth[sl][var] = q.state_depth
+        self.qc_state_tag[sl][var] = q.state_tag
+        self.qc_commit_valid[sl][var] = q.commit_valid
+        self.qc_commit_depth[sl][var] = q.commit_depth
+        self.qc_commit_tag[sl][var] = q.commit_tag
+        self.qc_author[sl][var] = q.author
+        self.qc_tag[sl][var] = q.tag
+        if q.round > self.hqc_round:
+            self.hqc_round = q.round
+            self.hqc_var = var
+        self.update_current_round(q.round + 1)
+        self.update_commit_chain(q.round, var)
+        return True
+
+    def insert_timeout(self, weights, t_epoch, t_round, t_hcbr, t_author):
+        author = min(max(t_author, 0), self.p.n_nodes - 1)
+        ok = (t_epoch == self.epoch_id and t_hcbr <= self.hqc_round
+              and t_round == self.current_round and not self.to_valid[author])
+        if not ok:
+            return False
+        self.to_valid[author] = True
+        self.to_hcbr[author] = t_hcbr
+        self.to_weight += weights[author]
+        if self.to_weight >= quorum_threshold(weights):
+            self.tc_valid = list(self.to_valid)
+            self.tc_hcbr = list(self.to_hcbr)
+            self.htc_round = self.current_round
+            self.update_current_round(self.current_round + 1)
+        return True
+
+    # -- creation
+    def make_block_tag(self, r, author, prev_round, prev_tag, time, cmd_proposer,
+                       cmd_index):
+        return fold(TAG_BLOCK, self.epoch_id & M32, r & M32, author & M32,
+                    prev_round & M32, prev_tag, time & M32, cmd_proposer & M32,
+                    cmd_index & M32)
+
+    def propose_block(self, weights, author, prev_round, prev_tag, time, cmd_index):
+        b = BlockMsg(
+            valid=True, round=self.current_round, author=author,
+            prev_round=prev_round, prev_tag=prev_tag, time=time,
+            cmd_proposer=author, cmd_index=cmd_index,
+            tag=self.make_block_tag(self.current_round, author, prev_round,
+                                    prev_tag, time, author, cmd_index),
+        )
+        return self.insert_block(weights, b, self.epoch_id)
+
+    def create_vote(self, weights, author, blk_round, blk_var):
+        sl = self._slot(blk_round)
+        cs_ok, cs_d, cs_t, _ = self.vote_committed_state(blk_round, blk_var)
+        exec_ok, st_d, st_t = self.compute_state(blk_round, blk_var)
+        v = VoteMsg(
+            valid=exec_ok, epoch=self.epoch_id, round=blk_round,
+            blk_tag=self.blk_tag[sl][blk_var], state_depth=st_d, state_tag=st_t,
+            commit_valid=cs_ok, commit_depth=cs_d, commit_tag=cs_t, author=author,
+        )
+        return self.insert_vote(weights, v) and exec_ok
+
+    def create_timeout(self, weights, author, round_):
+        return self.insert_timeout(weights, self.epoch_id, round_, self.hqc_round,
+                                   author)
+
+    def has_timeout(self, author, round_):
+        return round_ == self.current_round and self.to_valid[max(author, 0)]
+
+    def check_new_qc(self, weights, author):
+        if self.election != ELECTION_WON:
+            return False
+        bvar = self.won_var
+        sl = self._slot(self.current_round)
+        if self.blk_author[sl][bvar] != author:
+            return False
+        st_d = self.bal_state_depth[bvar][self.won_slot]
+        st_t = self.bal_state_tag[bvar][self.won_slot]
+        cs_ok, cs_d, cs_t, _ = self.vote_committed_state(self.current_round, bvar)
+        lo = hi = 0
+        for i in range(self.p.n_nodes):
+            m = (self.vt_valid[i] and self.vt_state_depth[i] == st_d
+                 and self.vt_state_tag[i] == st_t and self.vt_blk_var[i] == bvar)
+            if m and i < 32:
+                lo |= 1 << i
+            elif m:
+                hi |= 1 << (i - 32)
+        tag = fold(TAG_QC, self.epoch_id & M32, self.current_round & M32,
+                   self.blk_tag[sl][bvar], st_d & M32, st_t,
+                   int(cs_ok) & M32, cs_d & M32, cs_t, lo, hi, author & M32)
+        q = QcMsg(
+            valid=True, epoch=self.epoch_id, round=self.current_round,
+            blk_tag=self.blk_tag[sl][bvar], state_depth=st_d, state_tag=st_t,
+            commit_valid=cs_ok, commit_depth=cs_d, commit_tag=cs_t,
+            author=author, tag=tag,
+        )
+        self.election = ELECTION_CLOSED
+        self.insert_qc(weights, q)
+        return True
+
+    def committed_states_after(self, after_round):
+        """Ascending (round, depth, tag), mirroring the tensor version."""
+        W = self.p.window
+        start_r = self.hcc_round if self.hcc_valid else 0
+        hops = self.qc_walk_back(self.hcc_valid, start_r, self.hcc_var, W)
+        skip = self.p.commit_chain - 1
+        out = []
+        for i, (valid, r, v, _) in enumerate(hops):
+            if valid and i >= skip and r > after_round:
+                sl = self._slot(r)
+                out.append((r, self.qc_state_depth[sl][v], self.qc_state_tag[sl][v]))
+        return list(reversed(out))

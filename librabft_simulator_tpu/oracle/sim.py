@@ -1,0 +1,588 @@
+"""Oracle counterpart of core/{pacemaker,node,data_sync}.py and
+sim/simulator.py: the full event loop in plain Python.
+
+Every decision mirrors the tensor path exactly (same rng counters, same
+candidate ordering, same queue slot assignment), so whole trajectories are
+bit-comparable.  See tests/test_parity.py.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from ..core.types import (
+    KIND_NOTIFY, KIND_REQUEST, KIND_RESPONSE, KIND_TIMER, SimParams,
+)
+from ..sim.simulator import EQUIV_SALT
+from ..utils.quantile import TABLE_BITS
+from . import engine as E
+
+NEVER = E.NEVER
+
+
+@dataclasses.dataclass
+class Pacemaker:
+    active_epoch: int = 0
+    active_round: int = 0
+    active_leader: int = -1
+    round_start: int = 0
+    round_duration: int = 0
+
+
+@dataclasses.dataclass
+class NodeExtra:
+    latest_voted_round: int = 0
+    locked_round: int = 0
+    latest_query_all: int = 0
+    tracker_epoch: int = 0
+    tracker_hcr: int = 0
+    tracker_commit_time: int = 0
+
+
+class Context:
+    def __init__(self, p: SimParams):
+        self.p = p
+        self.next_cmd_index = 0
+        self.commit_count = 0
+        self.last_depth = 0
+        self.last_tag = E.initial_state_tag()
+        self.sync_jumps = 0
+        H = p.commit_log
+        self.log_round = [0] * H
+        self.log_depth = [0] * H
+        self.log_tag = [0] * H
+
+
+def round_duration(p: SimParams, dur_table, active_round, hcr):
+    hccr = hcr + 2 if hcr > 0 else 0
+    n = min(max(active_round - hccr, 0), p.dur_table_size - 1)
+    return int(dur_table[n])
+
+
+@dataclasses.dataclass
+class PacemakerActions:
+    should_propose: bool
+    propose_prev_round: int
+    propose_prev_tag: int
+    should_create_timeout: bool
+    timeout_round: int
+    send_leader: int
+    should_broadcast: bool
+    should_query_all: bool
+    next_sched: int
+
+
+def update_pacemaker(p, pm: Pacemaker, s: E.Store, weights, author, epoch_id,
+                     latest_query_all, clock, dur_table):
+    active_round = max(s.hqc_round, s.htc_round) + 1
+    enter = (epoch_id > pm.active_epoch) or (
+        epoch_id == pm.active_epoch and active_round > pm.active_round)
+    if enter:
+        pm.active_epoch = epoch_id
+        pm.active_round = active_round
+        pm.active_leader = E.leader_of_round(weights, active_round)
+        pm.round_start = clock
+        pm.round_duration = round_duration(p, dur_table, active_round, s.hcr)
+    send_leader = pm.active_leader if (enter and pm.active_leader != author) else -1
+
+    next_sched = NEVER
+    has_prop = proposed_block_valid(pm, s)
+    hqc_r, hqc_t = s.hqc_ref()
+    should_propose = pm.active_leader == author and not has_prop
+    should_broadcast = should_propose
+    if should_propose:
+        next_sched = clock
+
+    has_to = s.has_timeout(author, pm.active_round)
+    deadline = pm.round_start + pm.round_duration
+    past_deadline = clock >= deadline
+    should_create_timeout = (not has_to) and past_deadline
+    should_broadcast = should_broadcast or should_create_timeout
+    if (not has_to) and not past_deadline:
+        next_sched = min(next_sched, deadline)
+    period = (p.lam_fp * pm.round_duration) >> 16
+    qad = latest_query_all + period
+    should_query_all = has_to and clock >= qad
+    if should_query_all:
+        qad = clock + period
+    if has_to:
+        next_sched = min(next_sched, qad)
+    return PacemakerActions(
+        should_propose, hqc_r, hqc_t, should_create_timeout, pm.active_round,
+        send_leader, should_broadcast, should_query_all, next_sched)
+
+
+def proposed_block_valid(pm: Pacemaker, s: E.Store):
+    return (pm.active_epoch == s.epoch_id and pm.active_round == s.current_round
+            and pm.active_leader >= 0 and s.proposed_var >= 0)
+
+
+@dataclasses.dataclass
+class NodeUpdateActions:
+    next_sched: int
+    send_mask: List[bool]
+    should_query_all: bool
+
+
+def update_node(p, s: E.Store, pm: Pacemaker, nx: NodeExtra, cx: Context,
+                weights, author, clock, dur_table):
+    n = p.n_nodes
+    pa = update_pacemaker(p, pm, s, weights, author, s.epoch_id,
+                          nx.latest_query_all, clock, dur_table)
+    send_mask = [(i == pa.send_leader and pa.send_leader >= 0) for i in range(n)]
+    if pa.should_create_timeout:
+        s.create_timeout(weights, author, pa.timeout_round)
+        nx.latest_voted_round = max(nx.latest_voted_round, pa.timeout_round)
+    if pa.should_propose:
+        s.propose_block(weights, author, pa.propose_prev_round,
+                        pa.propose_prev_tag, clock, cx.next_cmd_index)
+        cx.next_cmd_index += 1
+
+    has_prop = proposed_block_valid(pm, s)
+    bvar = max(s.proposed_var, 0)
+    block_round = s.current_round
+    proposer = s.blk_author[s._slot(block_round)][bvar]
+    prev_r = s.previous_round(block_round, bvar)
+    may_vote = (has_prop and block_round > nx.latest_voted_round
+                and prev_r >= nx.locked_round)
+    if may_vote:
+        second_prev = s.second_previous_round(block_round, bvar)
+        nx.latest_voted_round = block_round
+        nx.locked_round = max(nx.locked_round, second_prev)
+        voted = s.create_vote(weights, author, block_round, bvar)
+        if voted:
+            send_mask = [i == proposer for i in range(n)]
+
+    qc_created = s.check_new_qc(weights, author)
+    broadcast = pa.should_broadcast or qc_created
+    next_sched = clock if qc_created else pa.next_sched
+
+    process_commits(p, s, nx, cx, weights)
+
+    nx2, tr_query_all, tr_next = update_tracker(p, nx, s, clock)
+    query_all = pa.should_query_all or tr_query_all
+    next_sched = min(next_sched, tr_next)
+    if query_all:
+        nx.latest_query_all = clock
+    if broadcast:
+        send_mask = [m or (i != author) for i, m in enumerate(send_mask)]
+    return NodeUpdateActions(next_sched, send_mask, query_all)
+
+
+def process_commits(p, s: E.Store, nx: NodeExtra, cx: Context, weights):
+    commits = s.committed_states_after(nx.tracker_hcr)
+    H = p.commit_log
+    switch = False
+    sw_e = sw_d = 0
+    sw_t = 0
+    for (r, d, t) in commits:
+        if switch or d <= cx.last_depth:
+            continue
+        pos = cx.commit_count % H
+        cx.log_round[pos] = r
+        cx.log_depth[pos] = d
+        cx.log_tag[pos] = t
+        cx.commit_count += 1
+        cx.last_depth = d
+        cx.last_tag = t
+        new_epoch = d // p.commands_per_epoch
+        if new_epoch > s.epoch_id:
+            switch = True
+            sw_e, sw_d, sw_t = new_epoch, d, t
+    if switch:
+        fresh = E.Store(p)
+        fresh.epoch_id = sw_e
+        fresh.initial_tag = E.epoch_initial_tag(sw_e)
+        fresh.initial_state_depth = sw_d
+        fresh.initial_state_tag = sw_t
+        s.__dict__.update(fresh.__dict__)
+        nx.latest_voted_round = 0
+        nx.locked_round = 0
+
+
+def update_tracker(p, nx: NodeExtra, s: E.Store, clock):
+    epoch_adv = s.epoch_id > nx.tracker_epoch
+    commit_adv = s.hcr > nx.tracker_hcr
+    bump = epoch_adv or commit_adv
+    nx.tracker_epoch = max(nx.tracker_epoch, s.epoch_id)
+    if bump:
+        nx.tracker_hcr = s.hcr
+        nx.tracker_commit_time = clock
+    deadline = max(nx.tracker_commit_time, nx.latest_query_all) \
+        + p.target_commit_interval
+    should_query_all = clock >= deadline
+    if should_query_all:
+        deadline = clock + p.target_commit_interval
+    return nx, should_query_all, deadline
+
+
+# -- data sync ---------------------------------------------------------------
+
+
+def qc_msg_at(s: E.Store, r, var, valid):
+    sl = s._slot(r)
+    blk_var = s.qc_blk_var[sl][var]
+    return E.QcMsg(
+        valid=bool(valid), epoch=s.epoch_id, round=s.qc_round[sl][var],
+        blk_tag=s.blk_tag[sl][blk_var], state_depth=s.qc_state_depth[sl][var],
+        state_tag=s.qc_state_tag[sl][var],
+        commit_valid=s.qc_commit_valid[sl][var],
+        commit_depth=s.qc_commit_depth[sl][var],
+        commit_tag=s.qc_commit_tag[sl][var],
+        author=s.qc_author[sl][var], tag=s.qc_tag[sl][var],
+    )
+
+
+def blk_msg_at(s: E.Store, r, var, valid):
+    sl = s._slot(r)
+    return E.BlockMsg(
+        valid=bool(valid), round=s.blk_round[sl][var], author=s.blk_author[sl][var],
+        prev_round=s.blk_prev_round[sl][var], prev_tag=s.blk_prev_tag[sl][var],
+        time=s.blk_time[sl][var], cmd_proposer=s.blk_cmd_proposer[sl][var],
+        cmd_index=s.blk_cmd_index[sl][var], tag=s.blk_tag[sl][var],
+    )
+
+
+def own_vote_msg(p, s: E.Store, author):
+    a = min(max(author, 0), p.n_nodes - 1)
+    bvar = s.vt_blk_var[a]
+    sl = s._slot(s.current_round)
+    return E.VoteMsg(
+        valid=s.vt_valid[a], epoch=s.epoch_id, round=s.current_round,
+        blk_tag=s.blk_tag[sl][bvar], state_depth=s.vt_state_depth[a],
+        state_tag=s.vt_state_tag[a], commit_valid=s.vt_commit_valid[a],
+        commit_depth=s.vt_commit_depth[a], commit_tag=s.vt_commit_tag[a], author=a,
+    )
+
+
+def create_notification(p, s: E.Store, author) -> E.Payload:
+    pay = E.Payload.empty(p.n_nodes, p.chain_k)
+    pay.epoch = s.epoch_id
+    pay.hcc = qc_msg_at(s, s.hcc_round, s.hcc_var, s.hcc_valid)
+    pay.hqc = qc_msg_at(s, s.hqc_round, s.hqc_var, s.hqc_round > 0)
+    sl = s._slot(s.current_round)
+    prop_var = max(s.proposed_var, 0)
+    prop_valid = s.proposed_var >= 0 and s.blk_author[sl][prop_var] == author
+    pay.prop_blk = blk_msg_at(s, s.current_round, prop_var, prop_valid)
+    pay.vote = own_vote_msg(p, s, author)
+    pay.tc_to = E.TimeoutsMsg(s.htc_round, list(s.tc_valid), list(s.tc_hcbr))
+    pay.cur_to = E.TimeoutsMsg(s.current_round, list(s.to_valid), list(s.to_hcbr))
+    return pay
+
+
+def create_request(p, s: E.Store) -> E.Payload:
+    pay = E.Payload.empty(p.n_nodes, p.chain_k)
+    pay.epoch = s.epoch_id
+    pay.req_hqc_round = s.hqc_round
+    pay.req_hcr = s.hcr
+    return pay
+
+
+def _insert_timeout_batch(p, s: E.Store, weights, to_msg: E.TimeoutsMsg, rec_epoch):
+    for a in range(p.n_nodes):
+        if to_msg.valid[a]:
+            s.insert_timeout(weights, rec_epoch, to_msg.round, to_msg.hcbr[a], a)
+
+
+def handle_notification(p, s: E.Store, weights, pay: E.Payload):
+    should_sync = pay.epoch > s.epoch_id
+    if pay.hcc.valid:
+        s.insert_qc(weights, pay.hcc)
+        should_sync = should_sync or (
+            pay.hcc.epoch > s.epoch_id
+            or (pay.hcc.epoch == s.epoch_id and pay.hcc.round > s.hcr + 2))
+    if pay.hqc.valid:
+        s.insert_qc(weights, pay.hqc)
+        should_sync = should_sync or (
+            pay.hqc.epoch > s.epoch_id
+            or (pay.hqc.epoch == s.epoch_id and pay.hqc.round > s.hqc_round))
+    if pay.prop_blk.valid:
+        s.insert_block(weights, pay.prop_blk, pay.epoch)
+    _insert_timeout_batch(p, s, weights, pay.tc_to, pay.epoch)
+    _insert_timeout_batch(p, s, weights, pay.cur_to, pay.epoch)
+    if pay.vote.valid:
+        s.insert_vote(weights, pay.vote)
+    return should_sync
+
+
+def handle_request(p, s: E.Store, author, req: E.Payload) -> E.Payload:
+    resp = create_notification(p, s, author)
+    hops = s.qc_walk_back(s.hqc_round > 0, s.hqc_round, s.hqc_var, p.chain_k)
+    hops = list(reversed(hops))
+    resp.chain_blk = []
+    resp.chain_qc = []
+    for (valid, r, v, _) in hops:
+        bvar = s.qc_blk_var[s._slot(r)][v]
+        resp.chain_blk.append(blk_msg_at(s, r, bvar, valid))
+        resp.chain_qc.append(qc_msg_at(s, r, v, valid))
+    hcc_bvar = s.qc_blk_var[s._slot(s.hcc_round)][s.hcc_var]
+    resp.hcc_blk = blk_msg_at(s, s.hcc_round, hcc_bvar, s.hcc_valid)
+    resp.vote = dataclasses.replace(resp.vote, valid=False)
+    return resp
+
+
+def handle_response(p, s: E.Store, nx: NodeExtra, cx: Context, weights,
+                    pay: E.Payload):
+    gap_jump = pay.hqc.valid and (
+        pay.epoch > s.epoch_id
+        or pay.hqc.round > s.hqc_round + (p.window - p.chain_k))
+    chain_has_base = pay.chain_qc[0].valid
+    do_jump = gap_jump and chain_has_base
+    if do_jump:
+        base_qc = pay.chain_qc[0]
+        fresh = E.Store(p)
+        fresh.epoch_id = pay.epoch
+        fresh.initial_round = base_qc.round
+        fresh.initial_tag = base_qc.tag
+        fresh.initial_state_depth = base_qc.state_depth
+        fresh.initial_state_tag = base_qc.state_tag
+        fresh.current_round = base_qc.round + 1
+        fresh.hqc_round = base_qc.round
+        fresh.htc_round = base_qc.round
+        fresh.hcr = base_qc.round
+        fresh.anchored = True
+        s.__dict__.update(fresh.__dict__)
+        nx.latest_voted_round = 0
+        nx.locked_round = 0
+        if (pay.hcc.valid and pay.hcc.commit_valid
+                and pay.hcc.commit_depth > cx.last_depth):
+            cx.last_depth = pay.hcc.commit_depth
+            cx.last_tag = pay.hcc.commit_tag
+        cx.sync_jumps += 1
+    for i in range(p.chain_k):
+        if do_jump and i == 0:
+            continue
+        if pay.chain_blk[i].valid:
+            s.insert_block(weights, pay.chain_blk[i], pay.epoch)
+        if pay.chain_qc[i].valid:
+            s.insert_qc(weights, pay.chain_qc[i])
+    if pay.hcc_blk.valid:
+        s.insert_block(weights, pay.hcc_blk, pay.epoch)
+    if pay.hcc.valid:
+        s.insert_qc(weights, pay.hcc)
+    _insert_timeout_batch(p, s, weights, pay.tc_to, pay.epoch)
+    _insert_timeout_batch(p, s, weights, pay.cur_to, pay.epoch)
+    if pay.prop_blk.valid:
+        s.insert_block(weights, pay.prop_blk, pay.epoch)
+
+
+# -- the event loop ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Message:
+    valid: bool
+    time: int
+    kind: int
+    stamp: int
+    sender: int
+    receiver: int
+    payload: E.Payload
+
+
+class OracleSim:
+    """Mirror of sim/simulator.py::step over plain Python state."""
+
+    def __init__(self, p: SimParams, seed: int, weights=None,
+                 byz_equivocate=None, byz_silent=None):
+        self.p = p
+        self.seed = seed & E.M32
+        n = p.n_nodes
+        self.delay_table = p.delay_table()
+        self.dur_table = p.duration_table()
+        self.weights = list(weights) if weights is not None else [1] * n
+        self.byz_equivocate = list(byz_equivocate) if byz_equivocate is not None \
+            else [False] * n
+        self.byz_silent = list(byz_silent) if byz_silent is not None else [False] * n
+        self.stores = [E.Store(p) for _ in range(n)]
+        self.pms = [Pacemaker() for _ in range(n)]
+        self.nxs = [NodeExtra() for _ in range(n)]
+        self.ctxs = [Context(p) for _ in range(n)]
+        self.queue: List[Message] = [
+            Message(False, 0, 0, 0, 0, 0, E.Payload.empty(n, p.chain_k))
+            for _ in range(p.queue_cap)
+        ]
+        self.startup = [
+            int(self.delay_table[(E.rng_u32(self.seed, c) >> (32 - TABLE_BITS))]) + 1
+            for c in range(n)
+        ]
+        self.timer_time = list(self.startup)
+        self.timer_stamp = list(range(n))
+        self.clock = 0
+        self.stamp_ctr = n
+        self.halted = False
+        self.n_events = 0
+        self.n_msgs_sent = 0
+        self.n_msgs_dropped = 0
+        self.n_queue_full = 0
+        T = p.trace_cap
+        self.trace_node = [0] * T
+        self.trace_round = [0] * T
+        self.trace_time = [0] * T
+        self.trace_count = 0
+
+    def _select_event(self):
+        p = self.p
+        cm = p.queue_cap
+        times = [m.time if m.valid else NEVER for m in self.queue] + self.timer_time
+        kinds = [m.kind for m in self.queue] + [KIND_TIMER] * p.n_nodes
+        stamps = [m.stamp for m in self.queue] + self.timer_stamp
+        t_min = min(times)
+        c1 = [t == t_min for t in times]
+        k_best = max(k for k, c in zip(kinds, c1) if c)
+        c2 = [c and k == k_best for c, k in zip(c1, kinds)]
+        s_best = min(s for s, c in zip(stamps, c2) if c)
+        idx = next(i for i, (c, s) in enumerate(zip(c2, stamps)) if c and s == s_best)
+        return idx, t_min, idx >= cm
+
+    def _equivocated(self, pay: E.Payload) -> E.Payload:
+        b = pay.prop_blk
+        pay2 = copy.deepcopy(pay)
+        pay2.prop_blk.cmd_index = b.cmd_index + EQUIV_SALT
+        pay2.prop_blk.tag = E.fold(
+            E.TAG_BLOCK, pay.epoch & E.M32, b.round & E.M32, b.author & E.M32,
+            b.prev_round & E.M32, b.prev_tag, b.time & E.M32,
+            b.cmd_proposer & E.M32, (b.cmd_index + EQUIV_SALT) & E.M32)
+        pay2.vote = dataclasses.replace(pay2.vote, valid=False)
+        return pay2
+
+    def step(self):
+        p = self.p
+        n, cm = p.n_nodes, p.queue_cap
+        idx, t_min, is_timer = self._select_event()
+        if self.halted or t_min > p.max_clock:
+            self.halted = True
+            return
+        clock = max(self.clock, min(t_min, NEVER - 1))
+        if is_timer:
+            a = idx - cm
+            kind = KIND_TIMER
+            sender = 0
+            pay_in = E.Payload.empty(n, p.chain_k)
+        else:
+            msg = self.queue[idx]
+            kind = msg.kind
+            a = min(max(msg.receiver, 0), n - 1)
+            sender = msg.sender
+            pay_in = msg.payload
+            msg.valid = False
+
+        s, pm, nx, cx = self.stores[a], self.pms[a], self.nxs[a], self.ctxs[a]
+        local_clock = clock - self.startup[a]
+
+        is_notify = kind == KIND_NOTIFY and not is_timer
+        is_request = kind == KIND_REQUEST and not is_timer
+        is_response = kind == KIND_RESPONSE and not is_timer
+        do_update = is_timer or is_notify or is_response
+
+        should_sync = False
+        if is_notify:
+            should_sync = handle_notification(p, s, self.weights, pay_in)
+        elif is_response:
+            handle_response(p, s, nx, cx, self.weights, pay_in)
+
+        pm_round_before = pm.active_round
+        if do_update:
+            actions = update_node(p, s, pm, nx, cx, self.weights, a, local_clock,
+                                  self.dur_table)
+        else:
+            actions = NodeUpdateActions(NEVER, [False] * n, False)
+        if do_update and pm.active_round > pm_round_before:
+            if p.trace_cap > 0:
+                pos = self.trace_count % p.trace_cap
+                self.trace_node[pos] = a
+                self.trace_round[pos] = pm.active_round
+                self.trace_time[pos] = clock
+            self.trace_count += 1
+
+        silent = self.byz_silent[a]
+        want_sync_req = is_notify and should_sync and not silent
+        want_response = is_request and not silent
+        cand0_want = want_sync_req or want_response
+        cand0_kind = KIND_RESPONSE if want_response else KIND_REQUEST
+        cand0_recv = min(max(sender, 0), n - 1)
+
+        send_mask = [m and i != a and do_update and not silent
+                     for i, m in enumerate(actions.send_mask)]
+        query_mask = [
+            (actions.should_query_all and do_update and not silent and i != a)
+            for i in range(n)
+        ]
+
+        # Payload bank (mirrors simulator.py: computed on the post-update store).
+        notif = create_notification(p, s, a)
+        notif_b = self._equivocated(notif)
+        request = create_request(p, s)
+        response = handle_request(p, s, a, pay_in)
+
+        want = [cand0_want] + send_mask + query_mask
+        kinds = [cand0_kind] + [KIND_NOTIFY] * n + [KIND_REQUEST] * n
+        recvs = [cand0_recv] + list(range(n)) + list(range(n))
+        upper = [(i * 2 >= n) for i in range(n)]
+        pays = [response if want_response else request]
+        for i in range(n):
+            pays.append(notif_b if (self.byz_equivocate[a] and upper[i]) else notif)
+        pays += [request] * n
+
+        timer_gap = 1 if do_update else 0
+        pos = -1
+        stamps = []
+        for j, w in enumerate(want):
+            if w:
+                pos += 1
+            stamps.append(self.stamp_ctr + pos + (timer_gap if j > 0 else 0))
+        total_consumed = sum(want) + timer_gap
+        timer_stamp_new = self.stamp_ctr + (1 if cand0_want else 0)
+
+        free_slots = [i for i, m in enumerate(self.queue) if not m.valid]
+        rank = 0
+        for j, w in enumerate(want):
+            if not w:
+                continue
+            u_delay = E.rng_u32(self.seed, stamps[j] & E.M32)
+            u_drop = E.mix32(u_delay, 0x632BE59B)
+            delay = int(self.delay_table[u_delay >> (32 - TABLE_BITS)])
+            dropped = u_drop < p.drop_u32
+            if dropped:
+                self.n_msgs_dropped += 1
+                continue
+            if rank >= len(free_slots):
+                self.n_queue_full += 1
+                rank += 1
+                continue
+            slot = free_slots[rank]
+            rank += 1
+            self.queue[slot] = Message(
+                True, clock + delay, kinds[j], stamps[j], a, recvs[j],
+                copy.deepcopy(pays[j]))
+            self.n_msgs_sent += 1
+
+        if do_update:
+            next_g = NEVER if actions.next_sched >= NEVER else \
+                min(actions.next_sched + self.startup[a], NEVER)
+            self.timer_time[a] = max(next_g, clock + 1)
+            self.timer_stamp[a] = timer_stamp_new
+
+        self.clock = clock
+        self.stamp_ctr += total_consumed
+        self.n_events += 1
+
+    def run(self, max_events: int = 100000):
+        for _ in range(max_events):
+            if self.halted:
+                break
+            self.step()
+        return self
+
+    def committed_chain(self, node):
+        cx = self.ctxs[node]
+        H = self.p.commit_log
+        out = []
+        for i in range(max(cx.commit_count - H, 0), cx.commit_count):
+            pos = i % H
+            out.append((cx.log_depth[pos], cx.log_tag[pos]))
+        return out

@@ -1,0 +1,92 @@
+"""Sharded execution paths: dp over instances, mp over the author dimension.
+
+Two multi-chip strategies (usable together on a ('dp', 'mp') mesh):
+
+* **dp (instance parallelism)** — the default scale-out: the [B, ...] batch is
+  split across chips; the jitted vmapped step needs no cross-instance
+  communication, so XLA compiles a collective-free SPMD program.
+
+* **mp (author parallelism)** — inside an instance, per-author tables
+  (votes, timeouts, weights: the [N] axes) are split over 'mp'; quorum
+  aggregation (configuration.rs:43 ``count_votes``) becomes a
+  ``psum`` over the mp axis.  This is the pattern for very large committees
+  (N ≫ 64) where one chip's HBM or vector lanes shouldn't hold the whole
+  author axis.  Exposed as :func:`sharded_count_votes` /
+  :func:`sharded_quorum_reached` and exercised by ``dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..core.types import SimParams
+from ..sim import simulator as sim_ops
+from . import mesh as mesh_ops
+
+
+def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int):
+    """jit-compiled scan of ``num_steps`` events, batch dim sharded over the
+    mesh.  Input/output shardings are pinned so the compiled program is pure
+    SPMD with no resharding."""
+    run = sim_ops.make_run_fn(p, num_steps, batched=True)  # jitted vmapped scan
+    sh = mesh_ops.batch_sharding(mesh)
+
+    def sharded(st):
+        st = jax.lax.with_sharding_constraint(st, sh)
+        return run(st)
+
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int, chunk: int = 256):
+    """Host loop over sharded chunks until all instances halt."""
+    import numpy as np
+
+    run = make_sharded_run_fn(p, mesh, chunk)
+    state = mesh_ops.shard_batch(mesh, sim_ops.dedupe_buffers(state))
+    done_steps = 0
+    while done_steps < num_steps:
+        state = run(state)
+        done_steps += chunk
+        if bool(np.all(jax.device_get(state.halted))):
+            break
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Author-dim (mp) quorum aggregation via psum.
+# ---------------------------------------------------------------------------
+
+
+def sharded_count_votes(mesh: Mesh, weights, author_mask):
+    """count_votes (configuration.rs:43) with the author axis sharded over
+    'mp': each chip sums its local authors, then a psum over mp rides ICI."""
+
+    def local(w, m):
+        partial = jnp.sum(jnp.where(m, w, 0), axis=-1, keepdims=True)
+        return jax.lax.psum(partial, axis_name="mp")
+
+    f = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("mp"), P("mp")),
+        out_specs=P(),
+    )
+    return f(weights, author_mask)[0]
+
+
+def sharded_quorum_reached(mesh: Mesh, weights, author_mask):
+    """Whether the masked authors reach the 2N/3+1 quorum, computed with both
+    the mask sum and the total weight as mp-psums."""
+
+    def local(w, m):
+        got = jax.lax.psum(jnp.sum(jnp.where(m, w, 0), keepdims=True), "mp")
+        total = jax.lax.psum(jnp.sum(w, keepdims=True), "mp")
+        return got >= 2 * total // 3 + 1
+
+    f = shard_map(local, mesh=mesh, in_specs=(P("mp"), P("mp")), out_specs=P())
+    return f(weights, author_mask)[0]

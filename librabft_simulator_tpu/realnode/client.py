@@ -1,0 +1,63 @@
+"""Transaction load generator (/root/reference/node/src/client.rs):
+
+    python -m librabft_simulator_tpu.realnode.client --target 127.0.0.1:7101 \
+        --size 512 --rate 1000 --duration 10
+
+Sends fixed-size transactions at a steady rate to a node's mempool port.
+Sample transactions (every ``--sample-every``-th) start with a 0 byte + an
+8-byte counter id, mirroring the reference's benchmark tagging scheme.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+from .network import write_frame
+
+
+async def run_client(host: str, port: int, size: int, rate: float,
+                     duration: float, sample_every: int = 100) -> int:
+    reader, writer = await asyncio.open_connection(host, port)
+    interval = 1.0 / rate if rate > 0 else 0.0
+    sent = 0
+    counter = 0
+    t_end = time.monotonic() + duration
+    next_t = time.monotonic()
+    try:
+        while time.monotonic() < t_end:
+            if sample_every and sent % sample_every == 0:
+                counter += 1
+                tx = b"\x00" + counter.to_bytes(8, "big") + os.urandom(max(size - 9, 0))
+            else:
+                tx = b"\x01" + os.urandom(max(size - 1, 0))
+            await write_frame(writer, tx)
+            sent += 1
+            next_t += interval
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+    finally:
+        writer.close()
+    return sent
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="client")
+    ap.add_argument("--target", required=True, help="host:port of a mempool")
+    ap.add_argument("--size", type=int, default=512, help="transaction bytes")
+    ap.add_argument("--rate", type=float, default=1000.0, help="tx/s")
+    ap.add_argument("--duration", type=float, default=10.0, help="seconds")
+    ap.add_argument("--sample-every", type=int, default=100)
+    args = ap.parse_args(argv)
+    host, port = args.target.rsplit(":", 1)
+    sent = asyncio.run(run_client(host, int(port), args.size, args.rate,
+                                  args.duration, args.sample_every))
+    print(f"sent {sent} transactions", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

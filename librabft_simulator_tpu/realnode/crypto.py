@@ -1,0 +1,149 @@
+"""Crypto for the real-node deployment stack
+(/root/reference/crypto/src/lib.rs): Digest, Ed25519 keys, Signature,
+SignatureService.
+
+Uses the ``cryptography`` package's Ed25519 (same algorithm as the reference's
+ed25519-dalek) and SHA-512 truncated to 32 bytes for digests
+(crypto/src/lib.rs:33-58).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import dataclasses
+import hashlib
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+DIGEST_SIZE = 32
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Digest:
+    """32-byte digest (crypto/src/lib.rs:20-31)."""
+
+    data: bytes
+
+    def __post_init__(self):
+        assert len(self.data) == DIGEST_SIZE
+
+    def to_vec(self) -> bytes:
+        return self.data
+
+    def hex(self) -> str:
+        return self.data.hex()
+
+    @classmethod
+    def of(cls, *chunks: bytes) -> "Digest":
+        h = hashlib.sha512()
+        for c in chunks:
+            h.update(c)
+        return cls(h.digest()[:DIGEST_SIZE])
+
+
+class CryptoError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class PublicKey:
+    """crypto/src/lib.rs:62-108."""
+
+    data: bytes  # 32 raw bytes
+
+    def to_base64(self) -> str:
+        return base64.b64encode(self.data).decode()
+
+    @classmethod
+    def from_base64(cls, s: str) -> "PublicKey":
+        return cls(base64.b64decode(s))
+
+    def _key(self) -> Ed25519PublicKey:
+        return Ed25519PublicKey.from_public_bytes(self.data)
+
+
+@dataclasses.dataclass(frozen=True)
+class SecretKey:
+    """crypto/src/lib.rs:110-149 (stores seed||public like dalek's 64-byte)."""
+
+    data: bytes  # 32-byte seed + 32-byte public
+
+    def to_base64(self) -> str:
+        return base64.b64encode(self.data).decode()
+
+    @classmethod
+    def from_base64(cls, s: str) -> "SecretKey":
+        return cls(base64.b64decode(s))
+
+    def _key(self) -> Ed25519PrivateKey:
+        return Ed25519PrivateKey.from_private_bytes(self.data[:32])
+
+
+def generate_keypair() -> tuple[PublicKey, SecretKey]:
+    """generate_production_keypair (crypto/src/lib.rs:152-166)."""
+    sk = Ed25519PrivateKey.generate()
+    pub = sk.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+    seed = sk.private_bytes(
+        serialization.Encoding.Raw, serialization.PrivateFormat.Raw,
+        serialization.NoEncryption())
+    return PublicKey(pub), SecretKey(seed + pub)
+
+
+@dataclasses.dataclass(frozen=True)
+class Signature:
+    """crypto/src/lib.rs:169-211."""
+
+    data: bytes  # 64 bytes
+
+    @classmethod
+    def new(cls, digest: Digest, secret: SecretKey) -> "Signature":
+        return cls(secret._key().sign(digest.data))
+
+    def verify(self, digest: Digest, public_key: PublicKey) -> None:
+        try:
+            public_key._key().verify(self.data, digest.data)
+        except Exception as e:  # InvalidSignature
+            raise CryptoError(f"invalid signature: {e}") from e
+
+    @staticmethod
+    def verify_batch(digest: Digest, votes) -> None:
+        """votes: iterable of (PublicKey, Signature) (lib.rs:196-211)."""
+        for pk, sig in votes:
+            sig.verify(digest, pk)
+
+
+class SignatureService:
+    """Async signing service (crypto/src/lib.rs:213-238): requests are
+    serialized through a queue so the secret key lives in one task."""
+
+    def __init__(self, secret: SecretKey):
+        self._queue: asyncio.Queue = asyncio.Queue(100)
+        self._secret = secret
+        self._task: asyncio.Task | None = None
+
+    def _ensure_task(self):
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def _run(self):
+        while True:
+            digest, fut = await self._queue.get()
+            if not fut.cancelled():
+                fut.set_result(Signature.new(digest, self._secret))
+
+    async def request_signature(self, digest: Digest) -> Signature:
+        self._ensure_task()
+        fut = asyncio.get_event_loop().create_future()
+        await self._queue.put((digest, fut))
+        return await fut
+
+    def close(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

@@ -1,0 +1,252 @@
+"""bft-driver: configs, timer and the consensus core driving the LibraBFTv2
+state machine over real asyncio networking
+(/root/reference/bft-driver/src/{config,timer,consensus,context,core}.rs).
+
+The per-node protocol state machine is the *oracle* engine
+(:mod:`librabft_simulator_tpu.oracle`) — the same plain-Python interpreter
+whose semantics are parity-tested against the TPU path, here fed by real
+sockets and a real clock instead of the discrete-event queue.  Payloads are
+JSON frames (the reference uses bincode; the wire format is an implementation
+detail behind the MessageHandler boundary).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..core.types import KIND_NOTIFY, KIND_REQUEST, KIND_RESPONSE, SimParams
+from ..oracle import engine as E
+from ..oracle import sim as O
+from .crypto import Digest, PublicKey, SecretKey, Signature, SignatureService
+from .mempool import Committee, Mempool, Parameters
+from .network import Receiver, ReliableSender, SimpleSender, Writer
+from .store import Store
+
+log = logging.getLogger(__name__)
+
+Address = Tuple[str, int]
+
+
+@dataclasses.dataclass
+class NodeParameters:
+    """bft-driver/src/config.rs: protocol knobs (+ tensor-path capacities so
+    the state machine is identical to the simulated one)."""
+
+    target_commit_interval: int = 5_000_000
+    delta: int = 500         # ms: real networks need wider rounds than sim units
+    gamma: float = 1.5
+    lam: float = 0.5
+    sync_retry_delay: int = 1000
+
+    def to_sim_params(self, n_nodes: int) -> SimParams:
+        return SimParams(
+            n_nodes=n_nodes,
+            target_commit_interval=self.target_commit_interval,
+            delta=self.delta,
+            gamma=self.gamma,
+            lam=self.lam,
+            window=64,
+            # One catch-up can deliver up to window-(C-1) commits in a single
+            # update; commit_log must cover that or the ring-read below would
+            # drop/duplicate entries.
+            commit_log=64,
+            queue_cap=max(32, 4 * n_nodes),
+            max_clock=2**31 - 2,
+        )
+
+
+def _payload_to_json(pay: E.Payload) -> dict:
+    return dataclasses.asdict(pay)
+
+
+def _payload_from_json(d: dict, n: int, k: int) -> E.Payload:
+    pay = E.Payload.empty(n, k)
+    pay.epoch = d["epoch"]
+    pay.hcc = E.QcMsg(**d["hcc"])
+    pay.hqc = E.QcMsg(**d["hqc"])
+    pay.hcc_blk = E.BlockMsg(**d["hcc_blk"])
+    pay.prop_blk = E.BlockMsg(**d["prop_blk"])
+    pay.vote = E.VoteMsg(**d["vote"])
+    pay.tc_to = E.TimeoutsMsg(**d["tc_to"])
+    pay.cur_to = E.TimeoutsMsg(**d["cur_to"])
+    pay.chain_blk = [E.BlockMsg(**b) for b in d["chain_blk"]]
+    pay.chain_qc = [E.QcMsg(**q) for q in d["chain_qc"]]
+    pay.req_hqc_round = d["req_hqc_round"]
+    pay.req_hcr = d["req_hcr"]
+    return pay
+
+
+class Timer:
+    """bft-driver/src/timer.rs: a resettable deadline."""
+
+    def __init__(self):
+        self._deadline: Optional[float] = None
+        self._event = asyncio.Event()
+
+    def schedule(self, deadline_ms: float):
+        self._deadline = deadline_ms
+        self._event.set()
+
+    async def wait(self, now_ms) -> None:
+        while True:
+            if self._deadline is None:
+                await self._event.wait()
+                self._event.clear()
+                continue
+            delta = (self._deadline - now_ms()) / 1000.0
+            if delta <= 0:
+                self._deadline = None
+                return
+            try:
+                await asyncio.wait_for(self._event.wait(), timeout=delta)
+                self._event.clear()
+            except asyncio.TimeoutError:
+                pass
+
+
+class ConsensusCore:
+    """bft-driver/src/core.rs: the node main loop.
+
+    Wires: timer -> update_node; network notifications/requests/responses ->
+    oracle data-sync handlers -> update_node; update actions -> sends.
+    """
+
+    def __init__(self, index: int, committee: Committee, secret: SecretKey,
+                 params: NodeParameters, mempool: Optional[Mempool],
+                 store: Store, address: Address):
+        n = len(committee.authorities)
+        self.index = index
+        self.committee = committee
+        self.params = params
+        self.p = params.to_sim_params(n)
+        self.sig_service = SignatureService(secret)
+        self.mempool = mempool
+        self.store = store
+        self.address = address
+        self.weights = [committee.stake(name) for name in committee.names()]
+        self.s = E.Store(self.p)
+        self.pm = O.Pacemaker()
+        self.nx = O.NodeExtra()
+        self.cx = O.Context(self.p)
+        self.dur_table = self.p.duration_table()
+        self.sender = SimpleSender()
+        self.receiver = Receiver(address, self._handle)
+        self.timer = Timer()
+        self._t0 = time.monotonic()
+        self.committed: List[Tuple[int, int]] = []  # (depth, tag) log
+        # Commands: the wire identity of a command is (proposer, cmd_index)
+        # (simulated_context.rs Command); batch digests from the mempool map
+        # onto our own indices so committed local proposals can be resolved
+        # back to their transaction batches.
+        self.cmd_digests: Dict[int, "object"] = {}
+        self._peers = committee.broadcast_addresses(committee.names()[index])
+        self._running = False
+
+    def _drain_mempool(self) -> None:
+        """CommandFetcher hook: adopt sealed batch digests as the commands
+        behind our upcoming proposal indices (bft-driver/src/context.rs
+        fetch())."""
+        if self.mempool is None:
+            return
+        next_idx = max([self.cx.next_cmd_index] +
+                       [k + 1 for k in self.cmd_digests])
+        while True:
+            d = self.mempool.try_next_command()
+            if d is None:
+                break
+            self.cmd_digests[next_idx] = d
+            next_idx += 1
+
+    def batch_for_command(self, cmd_index: int):
+        """Digest of the batch proposed under our command index (if ours)."""
+        return self.cmd_digests.get(cmd_index)
+
+    def now(self) -> int:
+        return int((time.monotonic() - self._t0) * 1000)
+
+    # -- wire ----------------------------------------------------------------
+    def _frame(self, kind: int, pay: E.Payload) -> bytes:
+        return json.dumps({
+            "kind": kind, "sender": self.index, "pay": _payload_to_json(pay),
+        }).encode()
+
+    async def _handle(self, writer: Writer, message: bytes) -> None:
+        d = json.loads(message)
+        kind = d["kind"]
+        sender = d["sender"]
+        pay = _payload_from_json(d["pay"], self.p.n_nodes, self.p.chain_k)
+        if kind == KIND_NOTIFY:
+            should_sync = O.handle_notification(self.p, self.s, self.weights, pay)
+            if should_sync:
+                req = O.create_request(self.p, self.s)
+                await self._send_to(sender, KIND_REQUEST, req)
+            await self._update()
+        elif kind == KIND_REQUEST:
+            resp = O.handle_request(self.p, self.s, self.index, pay)
+            await self._send_to(sender, KIND_RESPONSE, resp)
+        elif kind == KIND_RESPONSE:
+            O.handle_response(self.p, self.s, self.nx, self.cx, self.weights, pay)
+            await self._update()
+
+    async def _send_to(self, peer_index: int, kind: int, pay: E.Payload) -> None:
+        name = self.committee.names()[peer_index]
+        addr = self.committee.address(name)
+        if addr:
+            await self.sender.send(addr, self._frame(kind, pay))
+
+    async def _broadcast(self, kind: int, pay: E.Payload) -> None:
+        await self.sender.broadcast(self._peers, self._frame(kind, pay))
+
+    # -- protocol ------------------------------------------------------------
+    async def _update(self) -> None:
+        self._drain_mempool()
+        before_commits = self.cx.commit_count
+        actions = O.update_node(self.p, self.s, self.pm, self.nx, self.cx,
+                                self.weights, self.index, self.now(),
+                                self.dur_table)
+        # Record freshly committed states (StateFinalizer::commit analog).
+        # Only the last H entries survive in the ring; start there (a state-
+        # sync jump can commit more than H states at once).
+        H = self.p.commit_log
+        for i in range(max(before_commits, self.cx.commit_count - H),
+                       self.cx.commit_count):
+            pos = i % H
+            self.committed.append(
+                (self.cx.log_depth[pos], self.cx.log_tag[pos]))
+        notif = O.create_notification(self.p, self.s, self.index)
+        if any(actions.send_mask):
+            for i, m in enumerate(actions.send_mask):
+                if m and i != self.index:
+                    await self._send_to(i, KIND_NOTIFY, notif)
+        if actions.should_query_all:
+            req = O.create_request(self.p, self.s)
+            for i in range(self.p.n_nodes):
+                if i != self.index:
+                    await self._send_to(i, KIND_REQUEST, req)
+        if actions.next_sched < E.NEVER:
+            self.timer.schedule(max(actions.next_sched, self.now() + 1))
+        else:
+            self.timer.schedule(self.now() + self.params.delta)
+
+    async def _timer_loop(self) -> None:
+        while self._running:
+            await self.timer.wait(self.now)
+            await self._update()
+
+    async def spawn(self) -> None:
+        self._running = True
+        await self.receiver.spawn()
+        self.timer.schedule(self.now() + 10)
+        self._task = asyncio.get_event_loop().create_task(self._timer_loop())
+
+    async def close(self) -> None:
+        self._running = False
+        self._task.cancel()
+        await self.receiver.close()
+        self.sender.close()
+        self.sig_service.close()

@@ -1,0 +1,182 @@
+"""Mempool: committee config, batch maker, batch processor
+(/root/reference/mempool/src/{config,batch_maker,processor,mempool}.rs).
+
+Clients send raw transaction frames to the mempool's TCP port; the BatchMaker
+seals them into batches by size or timeout (batch_maker.rs:58-86); the
+Processor hashes each batch, persists it, and exposes sealed batch digests to
+the consensus driver as commands to order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+from .crypto import Digest, PublicKey
+from .network import Receiver, Writer
+from .store import Store
+
+Address = Tuple[str, int]
+
+
+@dataclasses.dataclass
+class Parameters:
+    """mempool/src/config.rs:10-22."""
+
+    batch_size: int = 500_000
+    max_batch_delay: float = 0.2  # seconds (reference: 200 ms)
+
+
+@dataclasses.dataclass
+class Authority:
+    name: PublicKey
+    stake: int
+    address: Address          # consensus port
+    mempool_address: Address  # transaction ingress port
+
+
+class Committee:
+    """mempool/src/config.rs:31-77."""
+
+    def __init__(self, info: List[Authority], epoch: int = 0):
+        self.authorities: Dict[str, Authority] = {
+            a.name.to_base64(): a for a in info
+        }
+        self.epoch = epoch
+
+    def stake(self, name: PublicKey) -> int:
+        a = self.authorities.get(name.to_base64())
+        return a.stake if a else 0
+
+    def total_votes(self) -> int:
+        return sum(a.stake for a in self.authorities.values())
+
+    def quorum_threshold(self) -> int:
+        return 2 * self.total_votes() // 3 + 1
+
+    def validity_threshold(self) -> int:
+        return (self.total_votes() + 2) // 3
+
+    def address(self, name: PublicKey) -> Optional[Address]:
+        a = self.authorities.get(name.to_base64())
+        return a.address if a else None
+
+    def broadcast_addresses(self, myself: PublicKey) -> List[Address]:
+        me = myself.to_base64()
+        return [a.address for k, a in self.authorities.items() if k != me]
+
+    def names(self) -> List[PublicKey]:
+        return [a.name for a in self.authorities.values()]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "epoch": self.epoch,
+            "authorities": [
+                {"name": a.name.to_base64(), "stake": a.stake,
+                 "address": list(a.address),
+                 "mempool_address": list(a.mempool_address)}
+                for a in self.authorities.values()
+            ],
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Committee":
+        d = json.loads(s)
+        return cls(
+            [Authority(PublicKey.from_base64(a["name"]), a["stake"],
+                       tuple(a["address"]), tuple(a["mempool_address"]))
+             for a in d["authorities"]],
+            d.get("epoch", 0),
+        )
+
+
+class BatchMaker:
+    """mempool/src/batch_maker.rs:22-100."""
+
+    def __init__(self, params: Parameters, out_queue: asyncio.Queue):
+        self.params = params
+        self.out = out_queue
+        self._batch: List[bytes] = []
+        self._size = 0
+        self._task = asyncio.get_event_loop().create_task(self._timer_loop())
+
+    async def add_transaction(self, tx: bytes) -> None:
+        self._batch.append(tx)
+        self._size += len(tx)
+        if self._size >= self.params.batch_size:
+            await self._seal()
+
+    async def _seal(self) -> None:
+        if not self._batch:
+            return
+        batch, self._batch, self._size = self._batch, [], 0
+        payload = b"".join(len(t).to_bytes(4, "big") + t for t in batch)
+        await self.out.put(payload)
+
+    async def _timer_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.params.max_batch_delay)
+            await self._seal()
+
+    def close(self):
+        self._task.cancel()
+
+
+class Processor:
+    """mempool/src/processor.rs: hash the sealed batch, persist it, output the
+    digest as an orderable command."""
+
+    def __init__(self, store: Store, in_queue: asyncio.Queue,
+                 digest_queue: asyncio.Queue):
+        self.store = store
+        self.inq = in_queue
+        self.outq = digest_queue
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            batch = await self.inq.get()
+            digest = Digest.of(batch)
+            await self.store.write(digest.to_vec(), batch)
+            # Bounded: shed the oldest digest under backlog (the batch itself
+            # is already persisted; only the ordering hint is dropped).
+            if self.outq.full():
+                self.outq.get_nowait()
+            self.outq.put_nowait(digest)
+
+    def close(self):
+        self._task.cancel()
+
+
+class Mempool:
+    """mempool/src/mempool.rs: TCP ingress -> BatchMaker -> Processor."""
+
+    def __init__(self, address: Address, params: Parameters, store: Store):
+        self.digests: asyncio.Queue = asyncio.Queue(10_000)
+        self._sealed: asyncio.Queue = asyncio.Queue()
+        self.batch_maker = BatchMaker(params, self._sealed)
+        self.processor = Processor(store, self._sealed, self.digests)
+        self.receiver = Receiver(address, self._handle)
+
+    async def _handle(self, writer: Writer, message: bytes) -> None:
+        await self.batch_maker.add_transaction(message)
+
+    async def spawn(self) -> None:
+        await self.receiver.spawn()
+
+    async def next_command(self) -> Digest:
+        """The consensus driver's CommandFetcher hook."""
+        return await self.digests.get()
+
+    def try_next_command(self) -> Optional[Digest]:
+        try:
+            return self.digests.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+    async def close(self) -> None:
+        self.batch_maker.close()
+        self.processor.close()
+        await self.receiver.close()

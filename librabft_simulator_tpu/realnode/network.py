@@ -1,0 +1,209 @@
+"""Asyncio TCP networking for the real-node stack
+(/root/reference/network/src/{receiver,simple_sender,reliable_sender}.rs).
+
+Frames are length-delimited (4-byte big-endian length prefix), matching the
+reference's ``LengthDelimitedCodec`` default.  One connection task per peer;
+``ReliableSender`` retransmits with exponential backoff until an ACK frame
+arrives (reliable_sender.rs:120-190).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, List, Tuple
+
+log = logging.getLogger(__name__)
+
+Address = Tuple[str, int]
+# handler(writer, message) -> None; use writer to send replies/ACKs.
+MessageHandler = Callable[["Writer", bytes], Awaitable[None]]
+
+
+async def write_frame(w: asyncio.StreamWriter, data: bytes) -> None:
+    w.write(len(data).to_bytes(4, "big") + data)
+    await w.drain()
+
+
+async def read_frame(r: asyncio.StreamReader) -> bytes:
+    header = await r.readexactly(4)
+    size = int.from_bytes(header, "big")
+    return await r.readexactly(size)
+
+
+class Writer:
+    """Reply-side of a connection handed to MessageHandlers (receiver.rs:18)."""
+
+    def __init__(self, w: asyncio.StreamWriter):
+        self._w = w
+
+    async def send(self, data: bytes) -> None:
+        await write_frame(self._w, data)
+
+
+class Receiver:
+    """network/src/receiver.rs:31-90: accept connections, one runner each."""
+
+    def __init__(self, address: Address, handler: MessageHandler):
+        self.address = address
+        self.handler = handler
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+
+    async def spawn(self) -> None:
+        host, port = self.address
+        self._server = await asyncio.start_server(self._runner, host, port)
+        log.debug("listening on %s:%s", host, port)
+
+    async def _runner(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        w = Writer(writer)
+        self._conns.add(writer)
+        try:
+            while True:
+                msg = await read_frame(reader)
+                await self.handler(w, msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                asyncio.CancelledError):
+            log.debug("connection closed by peer %s", peer)
+        finally:
+            self._conns.discard(writer)
+            writer.close()
+
+    async def close(self) -> None:
+        if self._server:
+            self._server.close()
+            # Drop live connections so handler coroutines blocked in
+            # read_frame terminate (3.12 wait_closed waits for them).
+            for w in list(self._conns):
+                w.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
+
+
+class _Connection:
+    """One keep-alive connection task (simple_sender.rs:76-143)."""
+
+    def __init__(self, address: Address):
+        self.address = address
+        self.queue: asyncio.Queue = asyncio.Queue(1000)
+        self.task = asyncio.get_event_loop().create_task(self._run())
+
+    async def _run(self):
+        while True:
+            data = await self.queue.get()
+            try:
+                reader, writer = await asyncio.open_connection(*self.address)
+            except OSError as e:
+                log.debug("failed to connect to %s: %s", self.address, e)
+                continue  # best effort: drop this message
+            try:
+                await write_frame(writer, data)
+                while True:
+                    data = await self.queue.get()
+                    await write_frame(writer, data)
+            except (OSError, ConnectionResetError) as e:
+                log.debug("connection to %s failed: %s", self.address, e)
+            finally:
+                writer.close()
+
+
+class SimpleSender:
+    """Best-effort sender (simple_sender.rs:22-75)."""
+
+    def __init__(self):
+        self._connections: Dict[Address, _Connection] = {}
+
+    def _conn(self, address: Address) -> _Connection:
+        if address not in self._connections:
+            self._connections[address] = _Connection(address)
+        return self._connections[address]
+
+    async def send(self, address: Address, data: bytes) -> None:
+        await self._conn(address).queue.put(data)
+
+    async def broadcast(self, addresses: List[Address], data: bytes) -> None:
+        for a in addresses:
+            await self.send(a, data)
+
+    def close(self):
+        for c in self._connections.values():
+            c.task.cancel()
+        self._connections.clear()
+
+
+class _ReliableConnection:
+    """Retransmit-until-ACK connection (reliable_sender.rs:100-248)."""
+
+    RETRY_DELAY = 0.2
+    MAX_DELAY = 5.0
+
+    def __init__(self, address: Address):
+        self.address = address
+        self.queue: asyncio.Queue = asyncio.Queue(1000)
+        self.task = asyncio.get_event_loop().create_task(self._run())
+
+    async def _run(self):
+        delay = self.RETRY_DELAY
+        pending: list = []
+        while True:
+            if not pending:
+                pending.append(await self.queue.get())
+            data, fut = pending[0]
+            if fut.cancelled():
+                pending.pop(0)
+                continue
+            try:
+                reader, writer = await asyncio.open_connection(*self.address)
+                try:
+                    while pending:
+                        data, fut = pending[0]
+                        if fut.cancelled():
+                            pending.pop(0)
+                            continue
+                        await write_frame(writer, data)
+                        ack = await read_frame(reader)
+                        if not fut.cancelled():
+                            fut.set_result(ack)
+                        pending.pop(0)
+                        delay = self.RETRY_DELAY
+                        # Pick up any further queued messages without closing.
+                        while not self.queue.empty():
+                            pending.append(self.queue.get_nowait())
+                    # Wait for more work on the open socket.
+                    item = await self.queue.get()
+                    pending.append(item)
+                finally:
+                    writer.close()
+            except (OSError, asyncio.IncompleteReadError, ConnectionResetError):
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, self.MAX_DELAY)
+
+
+class ReliableSender:
+    """reliable_sender.rs:31-99: send returns a CancelHandler future that
+    resolves with the ACK payload."""
+
+    def __init__(self):
+        self._connections: Dict[Address, _ReliableConnection] = {}
+
+    def _conn(self, address: Address) -> _ReliableConnection:
+        if address not in self._connections:
+            self._connections[address] = _ReliableConnection(address)
+        return self._connections[address]
+
+    async def send(self, address: Address, data: bytes) -> asyncio.Future:
+        fut = asyncio.get_event_loop().create_future()
+        await self._conn(address).queue.put((data, fut))
+        return fut
+
+    async def broadcast(self, addresses: List[Address], data: bytes):
+        return [await self.send(a, data) for a in addresses]
+
+    def close(self):
+        for c in self._connections.values():
+            c.task.cancel()
+        self._connections.clear()

@@ -1,0 +1,94 @@
+"""Node binary (/root/reference/node/src/{main,node,config}.rs):
+
+    python -m librabft_simulator_tpu.realnode.node_main keys --filename n0.json
+    python -m librabft_simulator_tpu.realnode.node_main run \
+        --keys n0.json --committee committee.json --store db0 --parameters p.json
+
+Subcommands mirror the reference CLI: ``keys`` generates a keypair file;
+``run`` boots mempool + consensus core.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+
+from .crypto import PublicKey, SecretKey, generate_keypair
+from .driver import ConsensusCore, NodeParameters
+from .mempool import Committee, Mempool, Parameters
+from .store import Store
+
+
+def cmd_keys(args):
+    pub, sec = generate_keypair()
+    with open(args.filename, "w") as f:
+        json.dump({"name": pub.to_base64(), "secret": sec.to_base64()}, f, indent=2)
+    print(f"wrote {args.filename}")
+
+
+def load_parameters(path) -> tuple[Parameters, NodeParameters]:
+    if not path:
+        return Parameters(), NodeParameters()
+    with open(path) as f:
+        d = json.load(f)
+    mp = d.get("mempool", {})
+    cs = d.get("consensus", {})
+    return (
+        Parameters(**mp),
+        NodeParameters(**cs),
+    )
+
+
+async def run_node(args):
+    with open(args.keys) as f:
+        kd = json.load(f)
+    name = PublicKey.from_base64(kd["name"])
+    secret = SecretKey.from_base64(kd["secret"])
+    with open(args.committee) as f:
+        committee = Committee.from_json(f.read())
+    names = [n.to_base64() for n in committee.names()]
+    index = names.index(name.to_base64())
+    mp_params, node_params = load_parameters(args.parameters)
+
+    store = Store(f"{args.store}/db.log")
+    auth = committee.authorities[name.to_base64()]
+    mempool = Mempool(auth.mempool_address, mp_params, store)
+    await mempool.spawn()
+    core = ConsensusCore(index, committee, secret, node_params, mempool, store,
+                         auth.address)
+    await core.spawn()
+    logging.info("node %d listening on %s", index, auth.address)
+    try:
+        while True:
+            await asyncio.sleep(5)
+            print(f"[node {index}] commits={len(core.committed)} "
+                  f"round={core.s.current_round}", file=sys.stderr)
+    finally:
+        await core.close()
+        await mempool.close()
+        store.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="realnode")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    k = sub.add_parser("keys", help="generate a keypair file")
+    k.add_argument("--filename", required=True)
+    r = sub.add_parser("run", help="run a node")
+    r.add_argument("--keys", required=True)
+    r.add_argument("--committee", required=True)
+    r.add_argument("--store", required=True)
+    r.add_argument("--parameters", default=None)
+    args = ap.parse_args(argv)
+    if args.cmd == "keys":
+        cmd_keys(args)
+    else:
+        logging.basicConfig(level=logging.INFO)
+        asyncio.run(run_node(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Persistent KV store with notify_read (/root/reference/store/src/lib.rs).
+
+The reference wraps rocksdb behind a command channel.  Here: an append-only
+log file + in-memory index (crash-recoverable on reopen) behind an asyncio
+queue, with the same three commands — Write, Read, NotifyRead (a read that
+blocks until the key exists; store/src/lib.rs:44-57).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+class Store:
+    def __init__(self, path: str):
+        self.path = path
+        self._index: Dict[bytes, bytes] = {}
+        self._obligations: Dict[bytes, List[asyncio.Future]] = defaultdict(list)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._recover()
+        self._log = open(path, "ab")
+        self._lock = asyncio.Lock()
+
+    def _recover(self):
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + 8 <= len(data):
+            klen, vlen = struct.unpack_from(">II", data, off)
+            off += 8
+            if off + klen + vlen > len(data):
+                break  # torn tail write
+            key = data[off:off + klen]
+            off += klen
+            value = data[off:off + vlen]
+            off += vlen
+            self._index[key] = value
+
+    async def write(self, key: bytes, value: bytes) -> None:
+        async with self._lock:
+            self._log.write(struct.pack(">II", len(key), len(value)) + key + value)
+            self._log.flush()
+            self._index[key] = value
+            for fut in self._obligations.pop(key, []):
+                if not fut.cancelled():
+                    fut.set_result(value)
+
+    async def read(self, key: bytes) -> Optional[bytes]:
+        return self._index.get(key)
+
+    async def notify_read(self, key: bytes) -> bytes:
+        if key in self._index:
+            return self._index[key]
+        fut = asyncio.get_event_loop().create_future()
+        self._obligations[key].append(fut)
+        return await fut
+
+    def close(self):
+        self._log.close()

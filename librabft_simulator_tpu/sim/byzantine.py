@@ -1,0 +1,112 @@
+"""Byzantine fault injection and safety analysis (BASELINE config #4).
+
+The attack surface is wired into the core simulator
+(:mod:`~librabft_simulator_tpu.sim.simulator`):
+
+* ``byz_equivocate[a]``: node *a* sends a *conflicting* proposal (different
+  command, different hash) to the upper half of receivers — classic
+  equivocation.  The V=2 variant tables make the conflict observable.
+* ``byz_silent[a]``: node *a* crashes (never sends; still receives).
+
+This module builds fault-masked fleets, runs f-sweeps, and checks the safety
+invariant: no two honest nodes commit different state tags at the same depth
+(agreement over SimulatedContext.committed_history,
+/root/reference/bft-lib/src/simulated_context.rs:220).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import SimParams
+from . import simulator as S
+
+
+def byz_masks(p: SimParams, f: int, kind: str = "equivocate", authors=None):
+    """Masks marking ``f`` authors as faulty (default: the first ``f``).
+
+    ``authors`` overrides which indices are faulty.  Note the leader schedule
+    (config.leader_of_round) is a fixed pseudorandom sequence, so *which*
+    author is faulty determines how early a 3-consecutive-honest-leader
+    window exists — liveness timing depends on it, safety never does.
+    """
+    idx = np.arange(p.n_nodes)
+    m = np.isin(idx, np.asarray(authors)) if authors is not None else idx < f
+    eq = m if kind == "equivocate" else np.zeros_like(m)
+    silent = m if kind == "silent" else np.zeros_like(m)
+    return jnp.asarray(eq), jnp.asarray(silent)
+
+
+def init_fault_batch(p: SimParams, seeds, f: int, kind: str = "equivocate",
+                     authors=None):
+    eq, silent = byz_masks(p, f, kind, authors)
+    seeds = jnp.asarray(seeds).astype(jnp.uint32)
+    return jax.vmap(
+        lambda s: S.init_state(p, s, byz_equivocate=eq, byz_silent=silent)
+    )(seeds)
+
+
+def check_safety(st, honest_mask=None):
+    """Per-instance safety: across nodes, committed tags agree at equal depth.
+
+    Works on a batched SimState ([B] leading dim).  Returns a bool [B] array:
+    True = safe.  Comparison covers the ring log (the last ``commit_log``
+    commits of each node), which bounds memory like the rest of the design.
+    """
+    log_depth = np.asarray(jax.device_get(st.ctx.log_depth))  # [B, N, H]
+    log_tag = np.asarray(jax.device_get(st.ctx.log_tag))
+    commit_count = np.asarray(jax.device_get(st.ctx.commit_count))  # [B, N]
+    B, N, H = log_depth.shape
+    if honest_mask is None:
+        honest_mask = np.ones((N,), bool)
+    safe = np.ones((B,), bool)
+    for b in range(B):
+        seen: dict[int, int] = {}
+        for a in range(N):
+            if not honest_mask[a]:
+                continue
+            cc = int(commit_count[b, a])
+            for i in range(max(cc - H, 0), cc):
+                pos = i % H
+                d, t = int(log_depth[b, a, pos]), int(log_tag[b, a, pos])
+                if d in seen and seen[d] != t:
+                    safe[b] = False
+                seen[d] = t
+    return safe
+
+
+@dataclasses.dataclass
+class SweepResult:
+    f: int
+    kind: str
+    instances: int
+    safe_fraction: float
+    live_fraction: float   # fraction of instances with >=1 honest commit
+    mean_commits: float
+
+
+def f_sweep(p: SimParams, n_instances: int, f_values=None, kind: str = "equivocate",
+            seed0: int = 0):
+    """Sweep the number of faulty authors; returns per-f safety/liveness."""
+    if f_values is None:
+        f_values = list(range(0, p.n_nodes // 3 + 2))
+    out = []
+    for f in f_values:
+        seeds = np.arange(seed0, seed0 + n_instances, dtype=np.uint32)
+        st = init_fault_batch(p, seeds, f, kind)
+        st = S.run_to_completion(p, st, batched=True)
+        honest = np.arange(p.n_nodes) >= f
+        safe = check_safety(st, honest)
+        cc = np.asarray(jax.device_get(st.ctx.commit_count))[:, honest]
+        live = (cc.max(axis=1) > 0)
+        out.append(SweepResult(
+            f=f, kind=kind, instances=n_instances,
+            safe_fraction=float(safe.mean()),
+            live_fraction=float(live.mean()),
+            mean_commits=float(cc.mean()),
+        ))
+    return out

@@ -96,6 +96,10 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         n_msgs_sent=_i32(0),
         n_msgs_dropped=_i32(0),
         n_queue_full=_i32(0),
+        trace_node=jnp.zeros((p.trace_cap,), I32),
+        trace_round=jnp.zeros((p.trace_cap,), I32),
+        trace_time=jnp.zeros((p.trace_cap,), I32),
+        trace_count=_i32(0),
     )
 
 
@@ -194,9 +198,8 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     cand0_pay = jnp.where(want_response, _i32(3), _i32(2))
 
     send_mask = actions.send_mask & others & do_update & ~silent
-    # Equivocators send the conflicting proposal to the upper half of receivers.
-    upper = jnp.arange(n) >= (a + 1 + (n - 1) // 2 + 1)
-    upper = (jnp.arange(n) * 2 >= n)  # receivers in the upper index half
+    # Equivocators send the conflicting proposal to the upper index half.
+    upper = (jnp.arange(n) * 2 >= n)
     notif_sel = jnp.where(st.byz_equivocate[a] & upper, _i32(1), _i32(0))
     query_mask = jnp.where(actions.should_query_all & do_update & ~silent, others, False)
 
@@ -235,7 +238,9 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         jnp.where(free, free_rank, 2 * n + 1)
     ].set(jnp.arange(cm, dtype=I32), mode="drop")
     overflow = go & (rank >= n_free)
-    tgt = jnp.where(go & ~overflow, slot_of_rank[jnp.clip(rank, 0, 2 * n)], _i32(-1))
+    # Sentinel cm is out-of-bounds => scatter mode="drop" discards it
+    # (a -1 sentinel would WRAP to the last slot and corrupt the queue).
+    tgt = jnp.where(go & ~overflow, slot_of_rank[jnp.clip(rank, 0, 2 * n)], _i32(cm))
 
     out_pay = jax.tree.map(lambda bank: bank[pay_sel], payload_bank)
     queue = queue.replace(
@@ -251,15 +256,32 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     )
 
     # ---- Timer reschedule (process_node_actions, simulator.rs:310-324).
+    # Saturating add: next_sched + startup without int32 wrap (== the wide-int
+    # min(next + startup, NEVER) of the oracle and C++ engine).
     next_g = jnp.where(
         actions.next_sched >= NEVER, NEVER,
-        jnp.minimum(actions.next_sched + st.startup[a], NEVER),
+        actions.next_sched + jnp.minimum(st.startup[a], NEVER - actions.next_sched),
     )
     new_timer = jnp.maximum(next_g, clock + 1)
     timer_time = jnp.where(do_update, st.timer_time.at[a].set(new_timer), st.timer_time)
     timer_stamp = jnp.where(
         do_update, st.timer_stamp.at[a].set(timer_stamp_new), st.timer_stamp
     )
+
+    # ---- Round-switch trace (data_writer.rs:34-49): the handled node entered
+    # a higher pacemaker round.  Ring write; compiled out when trace_cap == 0.
+    switched = do_update & (pm_f.active_round > pm_a.active_round)
+    trace_count = st.trace_count + jnp.where(switched, 1, 0)
+    if p.trace_cap > 0:
+        # Index == cap is out-of-bounds and dropped (a -1 sentinel would wrap).
+        tpos = jnp.where(switched, jnp.remainder(st.trace_count, p.trace_cap),
+                         _i32(p.trace_cap))
+        trace_node = st.trace_node.at[tpos].set(a, mode="drop")
+        trace_round = st.trace_round.at[tpos].set(pm_f.active_round, mode="drop")
+        trace_time = st.trace_time.at[tpos].set(clock, mode="drop")
+    else:
+        trace_node, trace_round, trace_time = (
+            st.trace_node, st.trace_round, st.trace_time)
 
     return st.replace(
         store=_node_update(st.store, a, s_f),
@@ -276,6 +298,10 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         n_msgs_sent=st.n_msgs_sent + jnp.where(live, jnp.sum(go & ~overflow), 0),
         n_msgs_dropped=st.n_msgs_dropped + jnp.where(live, jnp.sum(dropped), 0),
         n_queue_full=st.n_queue_full + jnp.where(live, jnp.sum(overflow), 0),
+        trace_node=trace_node,
+        trace_round=trace_round,
+        trace_time=trace_time,
+        trace_count=trace_count,
     )
 
 

@@ -1,0 +1,1191 @@
+// Native single-instance LibraBFTv2 discrete-event engine.
+//
+// Mirrors the integer semantics of the tensorized JAX simulator
+// (librabft_simulator_tpu/sim/simulator.py) and the Python oracle
+// (librabft_simulator_tpu/oracle/{engine,sim}.py) exactly — same hashing,
+// same windowed record tables, same event ordering and rng counters — so a
+// trajectory is bit-comparable across all three implementations
+// (tests/test_native.py).  The reference's native runtime is the Rust
+// workspace at /root/reference; this is its C++ counterpart for the rebuilt
+// framework (fast host-side single-instance runs, e.g. the real-node driver
+// or spot-checking TPU fleets).
+//
+// Build: g++ -O2 -shared -fPIC -o libbft_engine.so engine.cpp
+// ABI:   extern "C" bft_run(...) — see librabft_simulator_tpu/native.py.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+using u32 = uint32_t;
+using i64 = long long;
+
+constexpr int NEVER = 2147483647;
+constexpr int KIND_NOTIFY = 0, KIND_REQUEST = 1, KIND_RESPONSE = 2, KIND_TIMER = 3;
+constexpr int EL_ONGOING = 0, EL_WON = 1, EL_CLOSED = 2;
+constexpr int EQUIV_SALT = 1 << 20;
+constexpr int TABLE_BITS = 10;
+
+constexpr u32 TAG_BLOCK = 0x9E3779B1u, TAG_QC = 0xC2B2AE3Du,
+              TAG_STATE = 0x165667B1u, TAG_EPOCH = 0x5851F42Du,
+              TAG_LEADER = 0x2545F491u, TAG_SEED = 0x9E447687u;
+
+u32 mix32(u32 h, u32 x) {
+  h ^= x;
+  h *= 0x9E3779B1u; h ^= h >> 16;
+  h *= 0x85EBCA6Bu; h ^= h >> 13;
+  h *= 0xC2B2AE35u; h ^= h >> 16;
+  return h;
+}
+
+template <typename... W>
+u32 fold(W... words) {
+  u32 h = 0x811C9DC5u;
+  u32 ws[] = {static_cast<u32>(words)...};
+  for (u32 w : ws) h = mix32(h, w);
+  return h;
+}
+
+u32 rng_u32(u32 seed, u32 counter) { return fold(TAG_SEED, seed, counter); }
+
+u32 state_tag_next(u32 prev, u32 proposer, u32 index, u32 time) {
+  return fold(TAG_STATE, prev, proposer, index, time);
+}
+
+u32 epoch_initial_tag(u32 e) { return fold(TAG_EPOCH, e); }
+u32 initial_state_tag() { return fold(TAG_STATE, 0u); }
+
+struct Params {
+  int n_nodes, window, queue_cap, chain_k, commit_log;
+  int commands_per_epoch, target_commit_interval, delta;
+  int lam_fp, commit_chain, max_clock, dur_table_size;
+  u32 drop_u32;
+  // tables appended by caller
+};
+
+struct BlockMsg {
+  bool valid = false;
+  int round = 0, author = 0, prev_round = 0, time = 0, cmd_proposer = 0,
+      cmd_index = 0;
+  u32 prev_tag = 0, tag = 0;
+};
+
+struct QcMsg {
+  bool valid = false, commit_valid = false;
+  int epoch = 0, round = 0, state_depth = 0, commit_depth = 0, author = 0;
+  u32 blk_tag = 0, state_tag = 0, commit_tag = 0, tag = 0;
+};
+
+struct VoteMsg {
+  bool valid = false, commit_valid = false;
+  int epoch = 0, round = 0, state_depth = 0, commit_depth = 0, author = 0;
+  u32 blk_tag = 0, state_tag = 0, commit_tag = 0;
+};
+
+struct TimeoutsMsg {
+  int round = 0;
+  std::vector<uint8_t> valid;
+  std::vector<int> hcbr;
+  explicit TimeoutsMsg(int n = 0) : valid(n, 0), hcbr(n, 0) {}
+};
+
+struct Payload {
+  int epoch = 0;
+  QcMsg hcc, hqc;
+  BlockMsg hcc_blk, prop_blk;
+  VoteMsg vote;
+  TimeoutsMsg tc_to, cur_to;
+  std::vector<BlockMsg> chain_blk;
+  std::vector<QcMsg> chain_qc;
+  int req_hqc_round = 0, req_hcr = 0;
+  Payload(int n = 0, int k = 0)
+      : tc_to(n), cur_to(n), chain_blk(k), chain_qc(k) {}
+};
+
+int quorum_threshold(const std::vector<int>& w) {
+  int t = 0;
+  for (int x : w) t += x;
+  return 2 * t / 3 + 1;
+}
+
+int pick_author(const std::vector<int>& w, u32 seed) {
+  int total = 0;
+  for (int x : w) total += x;
+  int target = static_cast<int>(seed % static_cast<u32>(total));
+  int cum = 0;
+  for (size_t i = 0; i < w.size(); i++) {
+    cum += w[i];
+    if (cum > target) return static_cast<int>(i);
+  }
+  return static_cast<int>(w.size()) - 1;
+}
+
+int leader_of_round(const std::vector<int>& w, int r) {
+  return pick_author(w, fold(TAG_LEADER, static_cast<u32>(r)));
+}
+
+struct Hop {
+  bool valid, hit;
+  int round, var;
+};
+
+struct Store {
+  const Params& p;
+  // [W][2] tables
+  std::vector<uint8_t> blk_valid, qc_valid, qc_commit_valid;
+  std::vector<int> blk_round, blk_author, blk_prev_round, blk_time,
+      blk_cmd_proposer, blk_cmd_index, qc_round, qc_blk_var, qc_state_depth,
+      qc_commit_depth, qc_author;
+  std::vector<u32> blk_prev_tag, blk_tag, qc_state_tag, qc_commit_tag, qc_tag;
+  // per-author
+  std::vector<uint8_t> vt_valid, vt_commit_valid, to_valid, tc_valid;
+  std::vector<int> vt_blk_var, vt_state_depth, vt_commit_depth, to_hcbr,
+      tc_hcbr;
+  std::vector<u32> vt_state_tag, vt_commit_tag;
+  // ballot [2][2]
+  uint8_t bal_used[2][2] = {};
+  int bal_weight[2][2] = {}, bal_state_depth[2][2] = {};
+  u32 bal_state_tag[2][2] = {};
+  int to_weight = 0;
+  int epoch_id = 0, initial_round = 0, initial_state_depth = 0;
+  u32 initial_tag, initial_state_tag_;
+  int current_round = 1, proposed_var = -1, election = EL_ONGOING, won_var = 0,
+      won_slot = 0, hqc_round = 0, hqc_var = 0, htc_round = 0, hcr = 0;
+  bool hcc_valid = false, anchored = false;
+  int hcc_round = 0, hcc_var = 0;
+
+  explicit Store(const Params& pp) : p(pp) { reset(); }
+
+  void reset() {
+    int W = p.window, N = p.n_nodes;
+    auto zi = [&](std::vector<int>& v) { v.assign(W * 2, 0); };
+    auto zu = [&](std::vector<u32>& v) { v.assign(W * 2, 0); };
+    auto zb = [&](std::vector<uint8_t>& v) { v.assign(W * 2, 0); };
+    zb(blk_valid); zi(blk_round); zi(blk_author); zi(blk_prev_round);
+    zu(blk_prev_tag); zi(blk_time); zi(blk_cmd_proposer); zi(blk_cmd_index);
+    zu(blk_tag);
+    zb(qc_valid); zi(qc_round); zi(qc_blk_var); zi(qc_state_depth);
+    zu(qc_state_tag); zb(qc_commit_valid); zi(qc_commit_depth);
+    zu(qc_commit_tag); zi(qc_author); zu(qc_tag);
+    vt_valid.assign(N, 0); vt_blk_var.assign(N, 0);
+    vt_state_depth.assign(N, 0); vt_state_tag.assign(N, 0);
+    vt_commit_valid.assign(N, 0); vt_commit_depth.assign(N, 0);
+    vt_commit_tag.assign(N, 0);
+    std::memset(bal_used, 0, sizeof bal_used);
+    std::memset(bal_weight, 0, sizeof bal_weight);
+    std::memset(bal_state_depth, 0, sizeof bal_state_depth);
+    std::memset(bal_state_tag, 0, sizeof bal_state_tag);
+    to_valid.assign(N, 0); to_hcbr.assign(N, 0); to_weight = 0;
+    tc_valid.assign(N, 0); tc_hcbr.assign(N, 0);
+    epoch_id = 0; initial_round = 0;
+    initial_tag = epoch_initial_tag(0);
+    initial_state_depth = 0; initial_state_tag_ = initial_state_tag();
+    current_round = 1; proposed_var = -1; election = EL_ONGOING;
+    won_var = won_slot = 0; hqc_round = hqc_var = htc_round = hcr = 0;
+    hcc_valid = false; hcc_round = hcc_var = 0; anchored = false;
+  }
+
+  int slot(int r) const { return ((r % p.window) + p.window) % p.window; }
+  int ix(int sl, int v) const { return sl * 2 + v; }
+
+  int blk_find(int r, u32 tag) const {
+    int sl = slot(r);
+    for (int v = 0; v < 2; v++)
+      if (blk_valid[ix(sl, v)] && blk_round[ix(sl, v)] == r &&
+          blk_tag[ix(sl, v)] == tag)
+        return v;
+    return -1;
+  }
+
+  int qc_find(int r, u32 tag) const {
+    int sl = slot(r);
+    for (int v = 0; v < 2; v++)
+      if (qc_valid[ix(sl, v)] && qc_round[ix(sl, v)] == r &&
+          qc_tag[ix(sl, v)] == tag)
+        return v;
+    return -1;
+  }
+
+  void hqc_ref(int& r, u32& tag) const {
+    if (hqc_round > initial_round) {
+      r = hqc_round;
+      tag = qc_tag[ix(slot(hqc_round), hqc_var)];
+    } else {
+      r = hqc_round;
+      tag = initial_tag;
+    }
+  }
+
+  // (found, prev_round, prev_var); prev_var -1 = initial QC.
+  bool prev_qc_of_block(int r, int var, int& pr, int& pv) const {
+    int sl = slot(r);
+    pr = blk_prev_round[ix(sl, var)];
+    u32 pt = blk_prev_tag[ix(sl, var)];
+    if (pr == initial_round && pt == initial_tag) {
+      pv = -1;
+      return true;
+    }
+    pv = qc_find(pr, pt);
+    return pv >= 0;
+  }
+
+  std::vector<Hop> qc_walk_back(bool start_valid, int start_round,
+                                int start_var, int steps) const {
+    std::vector<Hop> out;
+    bool alive = start_valid && start_round > initial_round;
+    int r = start_round, v = start_var;
+    for (int i = 0; i < steps; i++) {
+      int bvar = qc_blk_var[ix(slot(r), v)];
+      int pr, pv;
+      bool found = prev_qc_of_block(r, bvar, pr, pv);
+      bool hit = alive && found && pv < 0;
+      out.push_back({alive, hit, r, v});
+      bool alive2 = alive && found && pv >= 0;
+      if (alive2) { r = pr; v = pv; }
+      alive = alive2;
+    }
+    return out;
+  }
+
+  int previous_round(int r, int var) const {
+    return blk_prev_round[ix(slot(r), var)];
+  }
+
+  int second_previous_round(int r, int var) const {
+    int pr, pv;
+    bool found = prev_qc_of_block(r, var, pr, pv);
+    if (pv < 0 || !found) return initial_round;
+    int bvar = qc_blk_var[ix(slot(pr), pv)];
+    return blk_prev_round[ix(slot(pr), bvar)];
+  }
+
+  void vote_committed_state(int blk_round_, int blk_var, bool& ok, int& d,
+                            u32& t, bool& undet) const {
+    int C = p.commit_chain;
+    int pr, pv;
+    bool found0 = prev_qc_of_block(blk_round_, blk_var, pr, pv);
+    auto hops = qc_walk_back(found0 && pv >= 0, pr, std::max(pv, 0), C - 1);
+    ok = true;
+    int prev_r = blk_round_;
+    for (int i = 0; i < C - 1; i++) {
+      ok = ok && hops[i].valid && prev_r == hops[i].round + 1;
+      prev_r = hops[i].round;
+    }
+    bool touched = (found0 && pv < 0);
+    for (int i = 0; i < C - 1; i++) touched = touched || hops[i].hit;
+    undet = anchored && touched;
+    const Hop& last = hops[C - 2];
+    int sl = slot(last.round);
+    d = ok ? qc_state_depth[ix(sl, last.var)] : 0;
+    t = ok ? qc_state_tag[ix(sl, last.var)] : 0;
+  }
+
+  bool compute_state(int blk_round_, int blk_var, int& d, u32& t) const {
+    int pr, pv;
+    bool found = prev_qc_of_block(blk_round_, blk_var, pr, pv);
+    int base_d;
+    u32 base_t;
+    if (pv < 0) {
+      base_d = initial_state_depth;
+      base_t = initial_state_tag_;
+    } else {
+      base_d = qc_state_depth[ix(slot(pr), pv)];
+      base_t = qc_state_tag[ix(slot(pr), pv)];
+    }
+    int sl = slot(blk_round_);
+    t = state_tag_next(base_t, blk_cmd_proposer[ix(sl, blk_var)],
+                       blk_cmd_index[ix(sl, blk_var)], blk_time[ix(sl, blk_var)]);
+    d = base_d + 1;
+    return found;
+  }
+
+  void update_commit_chain(int qr, int qv) {
+    int C = p.commit_chain;
+    auto hops = qc_walk_back(true, qr, qv, C);
+    bool ok = true;
+    for (int i = 0; i < C; i++) {
+      ok = ok && hops[i].valid;
+      if (i > 0) ok = ok && hops[i - 1].round == hops[i].round + 1;
+    }
+    int r1 = hops[C - 1].round;
+    ok = ok && r1 > hcr;
+    if (ok) {
+      hcr = r1;
+      hcc_valid = true;
+      hcc_round = qr;
+      hcc_var = qv;
+    }
+  }
+
+  void update_current_round(int r) {
+    if (r > current_round) {
+      current_round = r;
+      proposed_var = -1;
+      std::fill(vt_valid.begin(), vt_valid.end(), 0);
+      std::fill(to_valid.begin(), to_valid.end(), 0);
+      to_weight = 0;
+      std::memset(bal_used, 0, sizeof bal_used);
+      std::memset(bal_weight, 0, sizeof bal_weight);
+      std::memset(bal_state_depth, 0, sizeof bal_state_depth);
+      std::memset(bal_state_tag, 0, sizeof bal_state_tag);
+      election = EL_ONGOING;
+      won_var = won_slot = 0;
+    }
+  }
+
+  void pick_variant(const uint8_t* valid_col, const int* round_col,
+                    const u32* tag_col, int r, u32 tag, int& var, bool& dup,
+                    bool& room) const {
+    bool stale0 = !valid_col[0] || round_col[0] != r;
+    bool stale1 = !valid_col[1] || round_col[1] != r;
+    bool dup0 = !stale0 && tag_col[0] == tag;
+    bool dup1 = !stale1 && tag_col[1] == tag;
+    dup = dup0 || dup1;
+    var = stale0 ? 0 : (stale1 ? 1 : -1);
+    room = var >= 0;
+  }
+
+  bool insert_block(const std::vector<int>& w, const BlockMsg& b,
+                    int rec_epoch) {
+    int sl = slot(b.round);
+    uint8_t vcol[2] = {blk_valid[ix(sl, 0)], blk_valid[ix(sl, 1)]};
+    int rcol[2] = {blk_round[ix(sl, 0)], blk_round[ix(sl, 1)]};
+    u32 tcol[2] = {blk_tag[ix(sl, 0)], blk_tag[ix(sl, 1)]};
+    int var; bool dup, room;
+    pick_variant(vcol, rcol, tcol, b.round, b.tag, var, dup, room);
+    bool prev_initial =
+        b.prev_round == initial_round && b.prev_tag == initial_tag;
+    bool prev_known = prev_initial || qc_find(b.prev_round, b.prev_tag) >= 0;
+    bool in_window = b.round > current_round - p.window;
+    bool ok = b.valid && rec_epoch == epoch_id && !dup && room && prev_known &&
+              b.round > b.prev_round && in_window;
+    if (!ok) return false;
+    var = std::max(var, 0);
+    int k = ix(sl, var);
+    blk_valid[k] = 1; blk_round[k] = b.round; blk_author[k] = b.author;
+    blk_prev_round[k] = b.prev_round; blk_prev_tag[k] = b.prev_tag;
+    blk_time[k] = b.time; blk_cmd_proposer[k] = b.cmd_proposer;
+    blk_cmd_index[k] = b.cmd_index; blk_tag[k] = b.tag;
+    if (b.round == current_round && leader_of_round(w, current_round) == b.author)
+      proposed_var = var;
+    return true;
+  }
+
+  bool insert_vote(const std::vector<int>& w, const VoteMsg& v) {
+    int author = std::min(std::max(v.author, 0), p.n_nodes - 1);
+    int bvar = blk_find(v.round, v.blk_tag);
+    bool cs_ok, cs_undet;
+    int cs_d;
+    u32 cs_t;
+    vote_committed_state(v.round, std::max(bvar, 0), cs_ok, cs_d, cs_t,
+                         cs_undet);
+    bool commit_match =
+        cs_undet ||
+        (v.commit_valid == cs_ok &&
+         (!cs_ok || (v.commit_depth == cs_d && v.commit_tag == cs_t)));
+    bool ok = v.valid && v.epoch == epoch_id && bvar >= 0 && commit_match &&
+              v.round == current_round && !vt_valid[author];
+    if (!ok) return false;
+    bvar = std::max(bvar, 0);
+    vt_valid[author] = 1; vt_blk_var[author] = bvar;
+    vt_state_depth[author] = v.state_depth; vt_state_tag[author] = v.state_tag;
+    vt_commit_valid[author] = v.commit_valid;
+    vt_commit_depth[author] = v.commit_depth;
+    vt_commit_tag[author] = v.commit_tag;
+    if (election != EL_ONGOING) return true;
+    bool m0 = bal_used[bvar][0] && bal_state_depth[bvar][0] == v.state_depth &&
+              bal_state_tag[bvar][0] == v.state_tag;
+    bool m1 = bal_used[bvar][1] && bal_state_depth[bvar][1] == v.state_depth &&
+              bal_state_tag[bvar][1] == v.state_tag;
+    int s;
+    if (m0) s = 0;
+    else if (m1) s = 1;
+    else if (!bal_used[bvar][0]) s = 0;
+    else if (!bal_used[bvar][1]) s = 1;
+    else return true;
+    bal_used[bvar][s] = 1;
+    bal_weight[bvar][s] += w[author];
+    bal_state_depth[bvar][s] = v.state_depth;
+    bal_state_tag[bvar][s] = v.state_tag;
+    if (bal_weight[bvar][s] >= quorum_threshold(w)) {
+      election = EL_WON;
+      won_var = bvar;
+      won_slot = s;
+    }
+    return true;
+  }
+
+  bool insert_qc(const std::vector<int>& w, const QcMsg& q) {
+    int sl = slot(q.round);
+    uint8_t vcol[2] = {qc_valid[ix(sl, 0)], qc_valid[ix(sl, 1)]};
+    int rcol[2] = {qc_round[ix(sl, 0)], qc_round[ix(sl, 1)]};
+    u32 tcol[2] = {qc_tag[ix(sl, 0)], qc_tag[ix(sl, 1)]};
+    int var; bool dup, room;
+    pick_variant(vcol, rcol, tcol, q.round, q.tag, var, dup, room);
+    int bvar = blk_find(q.round, q.blk_tag);
+    int bvar_c = std::max(bvar, 0);
+    bool author_ok = blk_author[ix(sl, bvar_c)] == q.author;
+    bool cs_ok, cs_undet;
+    int cs_d;
+    u32 cs_t;
+    vote_committed_state(q.round, bvar_c, cs_ok, cs_d, cs_t, cs_undet);
+    bool commit_match =
+        cs_undet ||
+        (q.commit_valid == cs_ok &&
+         (!cs_ok || (q.commit_depth == cs_d && q.commit_tag == cs_t)));
+    int st_d;
+    u32 st_t;
+    bool exec_ok = compute_state(q.round, bvar_c, st_d, st_t);
+    bool state_match = exec_ok && st_d == q.state_depth && st_t == q.state_tag;
+    bool in_window = q.round > current_round - p.window;
+    bool ok = q.valid && q.epoch == epoch_id && !dup && room && bvar >= 0 &&
+              author_ok && commit_match && state_match && in_window;
+    if (!ok) return false;
+    var = std::max(var, 0);
+    int k = ix(sl, var);
+    qc_valid[k] = 1; qc_round[k] = q.round; qc_blk_var[k] = bvar_c;
+    qc_state_depth[k] = q.state_depth; qc_state_tag[k] = q.state_tag;
+    qc_commit_valid[k] = q.commit_valid; qc_commit_depth[k] = q.commit_depth;
+    qc_commit_tag[k] = q.commit_tag; qc_author[k] = q.author; qc_tag[k] = q.tag;
+    if (q.round > hqc_round) { hqc_round = q.round; hqc_var = var; }
+    update_current_round(q.round + 1);
+    update_commit_chain(q.round, var);
+    return true;
+  }
+
+  bool insert_timeout(const std::vector<int>& w, int t_epoch, int t_round,
+                      int t_hcbr, int t_author) {
+    int author = std::min(std::max(t_author, 0), p.n_nodes - 1);
+    bool ok = t_epoch == epoch_id && t_hcbr <= hqc_round &&
+              t_round == current_round && !to_valid[author];
+    if (!ok) return false;
+    to_valid[author] = 1;
+    to_hcbr[author] = t_hcbr;
+    to_weight += w[author];
+    if (to_weight >= quorum_threshold(w)) {
+      tc_valid = to_valid;
+      tc_hcbr = to_hcbr;
+      htc_round = current_round;
+      update_current_round(current_round + 1);
+    }
+    return true;
+  }
+
+  u32 make_block_tag(int r, int author, int prev_round, u32 prev_tag, int time,
+                     int cmd_proposer, int cmd_index) const {
+    return fold(TAG_BLOCK, (u32)epoch_id, (u32)r, (u32)author, (u32)prev_round,
+                prev_tag, (u32)time, (u32)cmd_proposer, (u32)cmd_index);
+  }
+
+  bool propose_block(const std::vector<int>& w, int author, int prev_round,
+                     u32 prev_tag, int time, int cmd_index) {
+    BlockMsg b;
+    b.valid = true; b.round = current_round; b.author = author;
+    b.prev_round = prev_round; b.prev_tag = prev_tag; b.time = time;
+    b.cmd_proposer = author; b.cmd_index = cmd_index;
+    b.tag = make_block_tag(current_round, author, prev_round, prev_tag, time,
+                           author, cmd_index);
+    return insert_block(w, b, epoch_id);
+  }
+
+  bool create_vote(const std::vector<int>& w, int author, int blk_round_,
+                   int blk_var) {
+    int sl = slot(blk_round_);
+    bool cs_ok, cs_undet;
+    int cs_d;
+    u32 cs_t;
+    vote_committed_state(blk_round_, blk_var, cs_ok, cs_d, cs_t, cs_undet);
+    int st_d;
+    u32 st_t;
+    bool exec_ok = compute_state(blk_round_, blk_var, st_d, st_t);
+    VoteMsg v;
+    v.valid = exec_ok; v.epoch = epoch_id; v.round = blk_round_;
+    v.blk_tag = blk_tag[ix(sl, blk_var)];
+    v.state_depth = st_d; v.state_tag = st_t;
+    v.commit_valid = cs_ok; v.commit_depth = cs_d; v.commit_tag = cs_t;
+    v.author = author;
+    return insert_vote(w, v) && exec_ok;
+  }
+
+  bool create_timeout(const std::vector<int>& w, int author, int round_) {
+    return insert_timeout(w, epoch_id, round_, hqc_round, author);
+  }
+
+  bool has_timeout(int author, int round_) const {
+    return round_ == current_round && to_valid[std::max(author, 0)];
+  }
+
+  bool check_new_qc(const std::vector<int>& w, int author) {
+    if (election != EL_WON) return false;
+    int bvar = won_var;
+    int sl = slot(current_round);
+    if (blk_author[ix(sl, bvar)] != author) return false;
+    int st_d = bal_state_depth[bvar][won_slot];
+    u32 st_t = bal_state_tag[bvar][won_slot];
+    bool cs_ok, cs_undet;
+    int cs_d;
+    u32 cs_t;
+    vote_committed_state(current_round, bvar, cs_ok, cs_d, cs_t, cs_undet);
+    u32 lo = 0, hi = 0;
+    for (int i = 0; i < p.n_nodes; i++) {
+      bool m = vt_valid[i] && vt_state_depth[i] == st_d &&
+               vt_state_tag[i] == st_t && vt_blk_var[i] == bvar;
+      if (m && i < 32) lo |= 1u << i;
+      else if (m) hi |= 1u << (i - 32);
+    }
+    u32 tag = fold(TAG_QC, (u32)epoch_id, (u32)current_round,
+                   blk_tag[ix(sl, bvar)], (u32)st_d, st_t, (u32)(cs_ok ? 1 : 0),
+                   (u32)cs_d, cs_t, lo, hi, (u32)author);
+    QcMsg q;
+    q.valid = true; q.epoch = epoch_id; q.round = current_round;
+    q.blk_tag = blk_tag[ix(sl, bvar)];
+    q.state_depth = st_d; q.state_tag = st_t;
+    q.commit_valid = cs_ok; q.commit_depth = cs_d; q.commit_tag = cs_t;
+    q.author = author; q.tag = tag;
+    election = EL_CLOSED;
+    insert_qc(w, q);
+    return true;
+  }
+
+  struct Commit { int round, depth; u32 tag; };
+
+  std::vector<Commit> committed_states_after(int after_round) const {
+    int W = p.window;
+    int start_r = hcc_valid ? hcc_round : 0;
+    auto hops = qc_walk_back(hcc_valid, start_r, hcc_var, W);
+    int skip = p.commit_chain - 1;
+    std::vector<Commit> out;
+    for (int i = 0; i < (int)hops.size(); i++) {
+      if (hops[i].valid && i >= skip && hops[i].round > after_round) {
+        int sl = slot(hops[i].round);
+        out.push_back({hops[i].round, qc_state_depth[ix(sl, hops[i].var)],
+                       qc_state_tag[ix(sl, hops[i].var)]});
+      }
+    }
+    std::reverse(out.begin(), out.end());
+    return out;
+  }
+};
+
+struct Pacemaker {
+  int active_epoch = 0, active_round = 0, active_leader = -1, round_start = 0,
+      round_duration = 0;
+};
+
+struct NodeExtra {
+  int latest_voted_round = 0, locked_round = 0, latest_query_all = 0,
+      tracker_epoch = 0, tracker_hcr = 0, tracker_commit_time = 0;
+};
+
+struct Context {
+  int next_cmd_index = 0, commit_count = 0, last_depth = 0, sync_jumps = 0;
+  u32 last_tag = initial_state_tag();
+  std::vector<int> log_round, log_depth;
+  std::vector<u32> log_tag;
+  explicit Context(int H) : log_round(H, 0), log_depth(H, 0), log_tag(H, 0) {}
+};
+
+struct PacemakerActions {
+  bool should_propose = false, should_create_timeout = false,
+       should_broadcast = false, should_query_all = false;
+  int propose_prev_round = 0, timeout_round = 0, send_leader = -1,
+      next_sched = NEVER;
+  u32 propose_prev_tag = 0;
+};
+
+struct NodeActions {
+  int next_sched = NEVER;
+  std::vector<uint8_t> send_mask;
+  bool should_query_all = false;
+};
+
+struct Engine {
+  Params p;
+  std::vector<int> delay_table, dur_table, weights;
+  std::vector<uint8_t> byz_eq, byz_silent;
+  u32 seed;
+  std::vector<Store> stores;
+  std::vector<Pacemaker> pms;
+  std::vector<NodeExtra> nxs;
+  std::vector<Context> ctxs;
+  struct Msg {
+    bool valid = false;
+    int time = 0, kind = 0, stamp = 0, sender = 0, receiver = 0;
+    Payload pay;
+  };
+  std::vector<Msg> queue;
+  std::vector<int> startup, timer_time, timer_stamp;
+  int clock = 0, stamp_ctr = 0;
+  bool halted = false;
+  i64 n_events = 0, n_msgs_sent = 0, n_msgs_dropped = 0, n_queue_full = 0;
+
+  Engine(const Params& pp, u32 sd, const int* dtab, const int* dur,
+         const int* w, const uint8_t* eq, const uint8_t* silent)
+      : p(pp), seed(sd) {
+    int n = p.n_nodes;
+    delay_table.assign(dtab, dtab + (1 << TABLE_BITS));
+    dur_table.assign(dur, dur + p.dur_table_size);
+    weights.assign(w, w + n);
+    byz_eq.assign(eq, eq + n);
+    byz_silent.assign(silent, silent + n);
+    for (int i = 0; i < n; i++) {
+      stores.emplace_back(p);
+      pms.emplace_back();
+      nxs.emplace_back();
+      ctxs.emplace_back(p.commit_log);
+    }
+    queue.assign(p.queue_cap, Msg{false, 0, 0, 0, 0, 0, Payload(n, p.chain_k)});
+    for (int c = 0; c < n; c++) {
+      int d = delay_table[rng_u32(seed, (u32)c) >> (32 - TABLE_BITS)] + 1;
+      startup.push_back(d);
+      timer_time.push_back(d);
+      timer_stamp.push_back(c);
+    }
+    stamp_ctr = n;
+  }
+
+  int round_duration(int active_round, int hcr) const {
+    int hccr = hcr > 0 ? hcr + 2 : 0;
+    int n = std::min(std::max(active_round - hccr, 0), p.dur_table_size - 1);
+    return dur_table[n];
+  }
+
+  bool proposed_block_valid(const Pacemaker& pm, const Store& s) const {
+    return pm.active_epoch == s.epoch_id && pm.active_round == s.current_round &&
+           pm.active_leader >= 0 && s.proposed_var >= 0;
+  }
+
+  PacemakerActions update_pacemaker(Pacemaker& pm, Store& s, int author,
+                                    int epoch_id, int latest_query_all,
+                                    int clk) {
+    PacemakerActions a;
+    int active_round = std::max(s.hqc_round, s.htc_round) + 1;
+    bool enter = epoch_id > pm.active_epoch ||
+                 (epoch_id == pm.active_epoch && active_round > pm.active_round);
+    if (enter) {
+      pm.active_epoch = epoch_id;
+      pm.active_round = active_round;
+      pm.active_leader = leader_of_round(weights, active_round);
+      pm.round_start = clk;
+      pm.round_duration = round_duration(active_round, s.hcr);
+    }
+    a.send_leader = (enter && pm.active_leader != author) ? pm.active_leader : -1;
+    a.next_sched = NEVER;
+    bool has_prop = proposed_block_valid(pm, s);
+    s.hqc_ref(a.propose_prev_round, a.propose_prev_tag);
+    a.should_propose = pm.active_leader == author && !has_prop;
+    a.should_broadcast = a.should_propose;
+    if (a.should_propose) a.next_sched = clk;
+    bool has_to = s.has_timeout(author, pm.active_round);
+    // Wide-int saturating sums: durations reach ~2^30, so int adds would be
+    // UB; mirror the tensor path's min(a + b, NEVER).
+    int deadline =
+        (int)std::min<i64>((i64)pm.round_start + pm.round_duration, NEVER);
+    bool past = clk >= deadline;
+    a.should_create_timeout = !has_to && past;
+    a.should_broadcast = a.should_broadcast || a.should_create_timeout;
+    a.timeout_round = pm.active_round;
+    if (!has_to && !past) a.next_sched = std::min(a.next_sched, deadline);
+    int period = (int)(((i64)p.lam_fp * pm.round_duration) >> 16);
+    int qad = (int)std::min<i64>((i64)latest_query_all + period, NEVER);
+    a.should_query_all = has_to && clk >= qad;
+    if (a.should_query_all)
+      qad = (int)std::min<i64>((i64)clk + period, NEVER);
+    if (has_to) a.next_sched = std::min(a.next_sched, qad);
+    return a;
+  }
+
+  void process_commits(Store& s, NodeExtra& nx, Context& cx) {
+    auto commits = s.committed_states_after(nx.tracker_hcr);
+    int H = p.commit_log;
+    bool sw = false;
+    int sw_e = 0, sw_d = 0;
+    u32 sw_t = 0;
+    for (auto& c : commits) {
+      if (sw || c.depth <= cx.last_depth) continue;
+      int pos = cx.commit_count % H;
+      cx.log_round[pos] = c.round;
+      cx.log_depth[pos] = c.depth;
+      cx.log_tag[pos] = c.tag;
+      cx.commit_count++;
+      cx.last_depth = c.depth;
+      cx.last_tag = c.tag;
+      int new_epoch = c.depth / p.commands_per_epoch;
+      if (new_epoch > s.epoch_id) {
+        sw = true;
+        sw_e = new_epoch;
+        sw_d = c.depth;
+        sw_t = c.tag;
+      }
+    }
+    if (sw) {
+      s.reset();
+      s.epoch_id = sw_e;
+      s.initial_tag = epoch_initial_tag((u32)sw_e);
+      s.initial_state_depth = sw_d;
+      s.initial_state_tag_ = sw_t;
+      nx.latest_voted_round = 0;
+      nx.locked_round = 0;
+    }
+  }
+
+  void update_tracker(NodeExtra& nx, const Store& s, int clk,
+                      bool& should_query_all, int& next_sched) {
+    bool epoch_adv = s.epoch_id > nx.tracker_epoch;
+    bool commit_adv = s.hcr > nx.tracker_hcr;
+    bool bump = epoch_adv || commit_adv;
+    nx.tracker_epoch = std::max(nx.tracker_epoch, s.epoch_id);
+    if (bump) {
+      nx.tracker_hcr = s.hcr;
+      nx.tracker_commit_time = clk;
+    }
+    i64 deadline = (i64)std::max(nx.tracker_commit_time, nx.latest_query_all) +
+                   p.target_commit_interval;
+    should_query_all = clk >= deadline;
+    if (should_query_all) deadline = (i64)clk + p.target_commit_interval;
+    next_sched = (int)std::min<i64>(deadline, NEVER);
+  }
+
+  NodeActions update_node(Store& s, Pacemaker& pm, NodeExtra& nx, Context& cx,
+                          int author, int clk) {
+    int n = p.n_nodes;
+    NodeActions out;
+    out.send_mask.assign(n, 0);
+    PacemakerActions pa =
+        update_pacemaker(pm, s, author, s.epoch_id, nx.latest_query_all, clk);
+    for (int i = 0; i < n; i++)
+      out.send_mask[i] = (i == pa.send_leader && pa.send_leader >= 0);
+    if (pa.should_create_timeout) {
+      s.create_timeout(weights, author, pa.timeout_round);
+      nx.latest_voted_round = std::max(nx.latest_voted_round, pa.timeout_round);
+    }
+    if (pa.should_propose) {
+      s.propose_block(weights, author, pa.propose_prev_round,
+                      pa.propose_prev_tag, clk, cx.next_cmd_index);
+      cx.next_cmd_index++;
+    }
+    bool has_prop = proposed_block_valid(pm, s);
+    int bvar = std::max(s.proposed_var, 0);
+    int block_round = s.current_round;
+    int proposer = s.blk_author[s.ix(s.slot(block_round), bvar)];
+    int prev_r = s.previous_round(block_round, bvar);
+    bool may_vote = has_prop && block_round > nx.latest_voted_round &&
+                    prev_r >= nx.locked_round;
+    if (may_vote) {
+      int second_prev = s.second_previous_round(block_round, bvar);
+      nx.latest_voted_round = block_round;
+      nx.locked_round = std::max(nx.locked_round, second_prev);
+      bool voted = s.create_vote(weights, author, block_round, bvar);
+      if (voted)
+        for (int i = 0; i < n; i++) out.send_mask[i] = (i == proposer);
+    }
+    bool qc_created = s.check_new_qc(weights, author);
+    bool broadcast = pa.should_broadcast || qc_created;
+    out.next_sched = qc_created ? clk : pa.next_sched;
+    process_commits(s, nx, cx);
+    bool tr_query;
+    int tr_next;
+    update_tracker(nx, s, clk, tr_query, tr_next);
+    out.should_query_all = pa.should_query_all || tr_query;
+    out.next_sched = std::min(out.next_sched, tr_next);
+    if (out.should_query_all) nx.latest_query_all = clk;
+    if (broadcast)
+      for (int i = 0; i < n; i++)
+        out.send_mask[i] = out.send_mask[i] || (i != author);
+    return out;
+  }
+
+  // ---- data sync ----------------------------------------------------------
+  QcMsg qc_msg_at(const Store& s, int r, int var, bool valid) const {
+    QcMsg q;
+    int sl = s.slot(r), k = s.ix(sl, var);
+    int bk = s.ix(sl, s.qc_blk_var[k]);
+    q.valid = valid; q.epoch = s.epoch_id; q.round = s.qc_round[k];
+    q.blk_tag = s.blk_tag[bk]; q.state_depth = s.qc_state_depth[k];
+    q.state_tag = s.qc_state_tag[k]; q.commit_valid = s.qc_commit_valid[k];
+    q.commit_depth = s.qc_commit_depth[k]; q.commit_tag = s.qc_commit_tag[k];
+    q.author = s.qc_author[k]; q.tag = s.qc_tag[k];
+    return q;
+  }
+
+  BlockMsg blk_msg_at(const Store& s, int r, int var, bool valid) const {
+    BlockMsg b;
+    int k = s.ix(s.slot(r), var);
+    b.valid = valid; b.round = s.blk_round[k]; b.author = s.blk_author[k];
+    b.prev_round = s.blk_prev_round[k]; b.prev_tag = s.blk_prev_tag[k];
+    b.time = s.blk_time[k]; b.cmd_proposer = s.blk_cmd_proposer[k];
+    b.cmd_index = s.blk_cmd_index[k]; b.tag = s.blk_tag[k];
+    return b;
+  }
+
+  VoteMsg own_vote_msg(const Store& s, int author) const {
+    int a = std::min(std::max(author, 0), p.n_nodes - 1);
+    VoteMsg v;
+    int bvar = s.vt_blk_var[a];
+    v.valid = s.vt_valid[a]; v.epoch = s.epoch_id; v.round = s.current_round;
+    v.blk_tag = s.blk_tag[s.ix(s.slot(s.current_round), bvar)];
+    v.state_depth = s.vt_state_depth[a]; v.state_tag = s.vt_state_tag[a];
+    v.commit_valid = s.vt_commit_valid[a];
+    v.commit_depth = s.vt_commit_depth[a];
+    v.commit_tag = s.vt_commit_tag[a];
+    v.author = a;
+    return v;
+  }
+
+  Payload create_notification(const Store& s, int author) const {
+    Payload pay(p.n_nodes, p.chain_k);
+    pay.epoch = s.epoch_id;
+    pay.hcc = qc_msg_at(s, s.hcc_round, s.hcc_var, s.hcc_valid);
+    pay.hqc = qc_msg_at(s, s.hqc_round, s.hqc_var, s.hqc_round > 0);
+    int sl = s.slot(s.current_round);
+    int prop_var = std::max(s.proposed_var, 0);
+    bool prop_valid =
+        s.proposed_var >= 0 && s.blk_author[s.ix(sl, prop_var)] == author;
+    pay.prop_blk = blk_msg_at(s, s.current_round, prop_var, prop_valid);
+    pay.vote = own_vote_msg(s, author);
+    pay.tc_to.round = s.htc_round;
+    pay.tc_to.valid = s.tc_valid;
+    pay.tc_to.hcbr = s.tc_hcbr;
+    pay.cur_to.round = s.current_round;
+    pay.cur_to.valid = s.to_valid;
+    pay.cur_to.hcbr = s.to_hcbr;
+    return pay;
+  }
+
+  Payload create_request(const Store& s) const {
+    Payload pay(p.n_nodes, p.chain_k);
+    pay.epoch = s.epoch_id;
+    pay.req_hqc_round = s.hqc_round;
+    pay.req_hcr = s.hcr;
+    return pay;
+  }
+
+  void insert_timeout_batch(Store& s, const TimeoutsMsg& tm, int rec_epoch) {
+    for (int a = 0; a < p.n_nodes; a++)
+      if (tm.valid[a]) s.insert_timeout(weights, rec_epoch, tm.round, tm.hcbr[a], a);
+  }
+
+  bool handle_notification(Store& s, const Payload& pay) {
+    bool should_sync = pay.epoch > s.epoch_id;
+    if (pay.hcc.valid) {
+      s.insert_qc(weights, pay.hcc);
+      should_sync =
+          should_sync || pay.hcc.epoch > s.epoch_id ||
+          (pay.hcc.epoch == s.epoch_id && pay.hcc.round > s.hcr + 2);
+    }
+    if (pay.hqc.valid) {
+      s.insert_qc(weights, pay.hqc);
+      should_sync =
+          should_sync || pay.hqc.epoch > s.epoch_id ||
+          (pay.hqc.epoch == s.epoch_id && pay.hqc.round > s.hqc_round);
+    }
+    if (pay.prop_blk.valid) s.insert_block(weights, pay.prop_blk, pay.epoch);
+    insert_timeout_batch(s, pay.tc_to, pay.epoch);
+    insert_timeout_batch(s, pay.cur_to, pay.epoch);
+    if (pay.vote.valid) s.insert_vote(weights, pay.vote);
+    return should_sync;
+  }
+
+  Payload handle_request(const Store& s, int author, const Payload&) const {
+    Payload resp = create_notification(s, author);
+    auto hops = s.qc_walk_back(s.hqc_round > 0, s.hqc_round, s.hqc_var,
+                               p.chain_k);
+    std::reverse(hops.begin(), hops.end());
+    for (int i = 0; i < p.chain_k; i++) {
+      int bvar = s.qc_blk_var[s.ix(s.slot(hops[i].round), hops[i].var)];
+      resp.chain_blk[i] = blk_msg_at(s, hops[i].round, bvar, hops[i].valid);
+      resp.chain_qc[i] = qc_msg_at(s, hops[i].round, hops[i].var, hops[i].valid);
+    }
+    int hcc_bvar = s.qc_blk_var[s.ix(s.slot(s.hcc_round), s.hcc_var)];
+    resp.hcc_blk = blk_msg_at(s, s.hcc_round, hcc_bvar, s.hcc_valid);
+    resp.vote.valid = false;
+    return resp;
+  }
+
+  void handle_response(Store& s, NodeExtra& nx, Context& cx,
+                       const Payload& pay) {
+    bool gap_jump =
+        pay.hqc.valid &&
+        (pay.epoch > s.epoch_id ||
+         pay.hqc.round > s.hqc_round + (p.window - p.chain_k));
+    bool do_jump = gap_jump && pay.chain_qc[0].valid;
+    if (do_jump) {
+      const QcMsg& base = pay.chain_qc[0];
+      s.reset();
+      s.epoch_id = pay.epoch;
+      s.initial_round = base.round;
+      s.initial_tag = base.tag;
+      s.initial_state_depth = base.state_depth;
+      s.initial_state_tag_ = base.state_tag;
+      s.current_round = base.round + 1;
+      s.hqc_round = base.round;
+      s.htc_round = base.round;
+      s.hcr = base.round;
+      s.anchored = true;
+      nx.latest_voted_round = 0;
+      nx.locked_round = 0;
+      if (pay.hcc.valid && pay.hcc.commit_valid &&
+          pay.hcc.commit_depth > cx.last_depth) {
+        cx.last_depth = pay.hcc.commit_depth;
+        cx.last_tag = pay.hcc.commit_tag;
+      }
+      cx.sync_jumps++;
+    }
+    for (int i = 0; i < p.chain_k; i++) {
+      if (do_jump && i == 0) continue;
+      if (pay.chain_blk[i].valid) s.insert_block(weights, pay.chain_blk[i], pay.epoch);
+      if (pay.chain_qc[i].valid) s.insert_qc(weights, pay.chain_qc[i]);
+    }
+    if (pay.hcc_blk.valid) s.insert_block(weights, pay.hcc_blk, pay.epoch);
+    if (pay.hcc.valid) s.insert_qc(weights, pay.hcc);
+    insert_timeout_batch(s, pay.tc_to, pay.epoch);
+    insert_timeout_batch(s, pay.cur_to, pay.epoch);
+    if (pay.prop_blk.valid) s.insert_block(weights, pay.prop_blk, pay.epoch);
+  }
+
+  Payload equivocated(const Payload& pay) const {
+    Payload p2 = pay;
+    const BlockMsg& b = pay.prop_blk;
+    p2.prop_blk.cmd_index = b.cmd_index + EQUIV_SALT;
+    p2.prop_blk.tag =
+        fold(TAG_BLOCK, (u32)pay.epoch, (u32)b.round, (u32)b.author,
+             (u32)b.prev_round, b.prev_tag, (u32)b.time, (u32)b.cmd_proposer,
+             (u32)(b.cmd_index + EQUIV_SALT));
+    p2.vote.valid = false;
+    return p2;
+  }
+
+  // ---- the event loop -----------------------------------------------------
+  void select_event(int& idx, int& t_min, bool& is_timer) const {
+    int cm = p.queue_cap, n = p.n_nodes;
+    t_min = NEVER;
+    for (int i = 0; i < cm; i++)
+      t_min = std::min(t_min, queue[i].valid ? queue[i].time : NEVER);
+    for (int i = 0; i < n; i++) t_min = std::min(t_min, timer_time[i]);
+    int k_best = -1;
+    for (int i = 0; i < cm; i++)
+      if (queue[i].valid && queue[i].time == t_min)
+        k_best = std::max(k_best, queue[i].kind);
+    for (int i = 0; i < n; i++)
+      if (timer_time[i] == t_min) k_best = std::max(k_best, KIND_TIMER);
+    int s_best = NEVER;
+    idx = -1;
+    for (int i = 0; i < cm; i++)
+      if (queue[i].valid && queue[i].time == t_min && queue[i].kind == k_best &&
+          queue[i].stamp < s_best) {
+        s_best = queue[i].stamp;
+      }
+    for (int i = 0; i < n; i++)
+      if (timer_time[i] == t_min && k_best == KIND_TIMER &&
+          timer_stamp[i] < s_best) {
+        s_best = timer_stamp[i];
+      }
+    for (int i = 0; i < cm && idx < 0; i++)
+      if (queue[i].valid && queue[i].time == t_min && queue[i].kind == k_best &&
+          queue[i].stamp == s_best)
+        idx = i;
+    for (int i = 0; i < n && idx < 0; i++)
+      if (timer_time[i] == t_min && k_best == KIND_TIMER &&
+          timer_stamp[i] == s_best)
+        idx = cm + i;
+    is_timer = idx >= cm;
+  }
+
+  void step() {
+    int n = p.n_nodes, cm = p.queue_cap;
+    int idx, t_min;
+    bool is_timer;
+    select_event(idx, t_min, is_timer);
+    if (halted || t_min > p.max_clock) {
+      halted = true;
+      return;
+    }
+    int clk = std::max(clock, std::min(t_min, NEVER - 1));
+    int kind, a, sender;
+    Payload pay_in(n, p.chain_k);
+    if (is_timer) {
+      a = idx - cm;
+      kind = KIND_TIMER;
+      sender = 0;
+    } else {
+      Msg& m = queue[idx];
+      kind = m.kind;
+      a = std::min(std::max(m.receiver, 0), n - 1);
+      sender = m.sender;
+      pay_in = m.pay;
+      m.valid = false;
+    }
+    Store& s = stores[a];
+    Pacemaker& pm = pms[a];
+    NodeExtra& nx = nxs[a];
+    Context& cx = ctxs[a];
+    int local_clock = clk - startup[a];
+
+    bool is_notify = kind == KIND_NOTIFY && !is_timer;
+    bool is_request = kind == KIND_REQUEST && !is_timer;
+    bool is_response = kind == KIND_RESPONSE && !is_timer;
+    bool do_update = is_timer || is_notify || is_response;
+
+    bool should_sync = false;
+    if (is_notify) should_sync = handle_notification(s, pay_in);
+    else if (is_response) handle_response(s, nx, cx, pay_in);
+
+    NodeActions actions;
+    actions.send_mask.assign(n, 0);
+    if (do_update) actions = update_node(s, pm, nx, cx, a, local_clock);
+
+    bool silent = byz_silent[a];
+    bool want_sync_req = is_notify && should_sync && !silent;
+    bool want_response = is_request && !silent;
+    bool cand0_want = want_sync_req || want_response;
+    int cand0_kind = want_response ? KIND_RESPONSE : KIND_REQUEST;
+    int cand0_recv = std::min(std::max(sender, 0), n - 1);
+
+    Payload notif = create_notification(s, a);
+    Payload notif_b = equivocated(notif);
+    Payload request = create_request(s);
+    Payload response = handle_request(s, a, pay_in);
+
+    int ncand = 2 * n + 1;
+    std::vector<uint8_t> want(ncand, 0);
+    std::vector<int> kinds(ncand), recvs(ncand), paysel(ncand, 2);
+    want[0] = cand0_want;
+    kinds[0] = cand0_kind;
+    recvs[0] = cand0_recv;
+    paysel[0] = want_response ? 3 : 2;
+    for (int i = 0; i < n; i++) {
+      want[1 + i] = actions.send_mask[i] && i != a && do_update && !silent;
+      kinds[1 + i] = KIND_NOTIFY;
+      recvs[1 + i] = i;
+      paysel[1 + i] = (byz_eq[a] && (i * 2 >= n)) ? 1 : 0;
+      want[1 + n + i] =
+          actions.should_query_all && do_update && !silent && i != a;
+      kinds[1 + n + i] = KIND_REQUEST;
+      recvs[1 + n + i] = i;
+      paysel[1 + n + i] = 2;
+    }
+    int timer_gap = do_update ? 1 : 0;
+    std::vector<int> stamps(ncand);
+    {
+      int pos = -1;
+      for (int j = 0; j < ncand; j++) {
+        if (want[j]) pos++;
+        stamps[j] = stamp_ctr + pos + (j > 0 ? timer_gap : 0);
+      }
+    }
+    int total_consumed = timer_gap;
+    for (int j = 0; j < ncand; j++) total_consumed += want[j] ? 1 : 0;
+    int timer_stamp_new = stamp_ctr + (cand0_want ? 1 : 0);
+
+    std::vector<int> free_slots;
+    for (int i = 0; i < cm; i++)
+      if (!queue[i].valid) free_slots.push_back(i);
+    size_t rank = 0;
+    for (int j = 0; j < ncand; j++) {
+      if (!want[j]) continue;
+      u32 u_delay = rng_u32(seed, (u32)stamps[j]);
+      u32 u_drop = mix32(u_delay, 0x632BE59Bu);
+      int delay = delay_table[u_delay >> (32 - TABLE_BITS)];
+      if (u_drop < p.drop_u32) {
+        n_msgs_dropped++;
+        continue;
+      }
+      if (rank >= free_slots.size()) {
+        n_queue_full++;
+        rank++;
+        continue;
+      }
+      Msg& m = queue[free_slots[rank++]];
+      m.valid = true;
+      m.time = clk + delay;
+      m.kind = kinds[j];
+      m.stamp = stamps[j];
+      m.sender = a;
+      m.receiver = recvs[j];
+      switch (paysel[j]) {
+        case 0: m.pay = notif; break;
+        case 1: m.pay = notif_b; break;
+        case 2: m.pay = request; break;
+        default: m.pay = response;
+      }
+      n_msgs_sent++;
+    }
+    if (do_update) {
+      i64 next_g = actions.next_sched >= NEVER
+                       ? (i64)NEVER
+                       : std::min<i64>((i64)actions.next_sched + startup[a], NEVER);
+      timer_time[a] = (int)std::max<i64>(next_g, (i64)clk + 1);
+      timer_stamp[a] = timer_stamp_new;
+    }
+    clock = clk;
+    stamp_ctr += total_consumed;
+    n_events++;
+  }
+
+  void run(i64 max_events) {
+    for (i64 i = 0; i < max_events && !halted; i++) step();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Flat result layout per node: commit_count, last_depth, last_tag,
+// current_round, hqc_round, hcr, sync_jumps  (7 i64 each), then the commit
+// ring: commit_log * 3 entries (round, depth, tag) per node.
+int bft_run(
+    // params
+    int n_nodes, int window, int queue_cap, int chain_k, int commit_log,
+    int commands_per_epoch, int target_commit_interval, int lam_fp,
+    int commit_chain, int max_clock, int dur_table_size, u32 drop_u32,
+    u32 seed, i64 max_events,
+    // tables / masks
+    const int* delay_table, const int* dur_table, const int* weights,
+    const uint8_t* byz_eq, const uint8_t* byz_silent,
+    // outputs
+    i64* global_out,  // [6]: n_events, clock, stamp_ctr, sent, dropped, full
+    i64* node_out,    // [n_nodes * 7]
+    i64* log_out      // [n_nodes * commit_log * 3]
+) {
+  Params p;
+  p.n_nodes = n_nodes; p.window = window; p.queue_cap = queue_cap;
+  p.chain_k = chain_k; p.commit_log = commit_log;
+  p.commands_per_epoch = commands_per_epoch;
+  p.target_commit_interval = target_commit_interval;
+  p.delta = 0; p.lam_fp = lam_fp; p.commit_chain = commit_chain;
+  p.max_clock = max_clock; p.dur_table_size = dur_table_size;
+  p.drop_u32 = drop_u32;
+  Engine e(p, seed, delay_table, dur_table, weights, byz_eq, byz_silent);
+  e.run(max_events);
+  global_out[0] = e.n_events;
+  global_out[1] = e.clock;
+  global_out[2] = e.stamp_ctr;
+  global_out[3] = e.n_msgs_sent;
+  global_out[4] = e.n_msgs_dropped;
+  global_out[5] = e.n_queue_full;
+  for (int a = 0; a < n_nodes; a++) {
+    const Store& s = e.stores[a];
+    const Context& c = e.ctxs[a];
+    i64* o = node_out + a * 7;
+    o[0] = c.commit_count;
+    o[1] = c.last_depth;
+    o[2] = c.last_tag;
+    o[3] = s.current_round;
+    o[4] = s.hqc_round;
+    o[5] = s.hcr;
+    o[6] = c.sync_jumps;
+    for (int i = 0; i < commit_log; i++) {
+      i64* l = log_out + (a * commit_log + i) * 3;
+      l[0] = c.log_round[i];
+      l[1] = c.log_depth[i];
+      l[2] = c.log_tag[i];
+    }
+  }
+  return e.halted ? 1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,95 @@
+"""Lockstep parity debugger: step JAX sim and oracle together, print first
+divergence in observable state."""
+
+import functools
+import sys
+
+sys.path.insert(0, ".")
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.oracle.sim import OracleSim
+from librabft_simulator_tpu.sim import simulator as S
+
+
+def snap_jax(st):
+    g = lambda x: np.asarray(jax.device_get(x))
+    return dict(
+        clock=int(st.clock), stamp=int(st.stamp_ctr), ev=int(st.n_events),
+        halted=bool(st.halted),
+        cur=g(st.store.current_round).tolist(),
+        hqc=g(st.store.hqc_round).tolist(),
+        htc=g(st.store.htc_round).tolist(),
+        hcr=g(st.store.hcr).tolist(),
+        cc=g(st.ctx.commit_count).tolist(),
+        lvr=g(st.node.latest_voted_round).tolist(),
+        lock=g(st.node.locked_round).tolist(),
+        tt=g(st.timer_time).tolist(),
+        ts=g(st.timer_stamp).tolist(),
+        qvalid=int(g(st.queue.valid).sum()),
+        qtimes=sorted(g(st.queue.time)[g(st.queue.valid)].tolist()),
+        qkinds=sorted(g(st.queue.kind)[g(st.queue.valid)].tolist()),
+        qstamps=sorted(g(st.queue.stamp)[g(st.queue.valid)].tolist()),
+        sent=int(st.n_msgs_sent), dropped=int(st.n_msgs_dropped),
+        full=int(st.n_queue_full),
+        pm_round=g(st.pm.active_round).tolist(),
+    )
+
+
+def snap_orc(o):
+    live = [m for m in o.queue if m.valid]
+    return dict(
+        clock=o.clock, stamp=o.stamp_ctr, ev=o.n_events, halted=o.halted,
+        cur=[s.current_round for s in o.stores],
+        hqc=[s.hqc_round for s in o.stores],
+        htc=[s.htc_round for s in o.stores],
+        hcr=[s.hcr for s in o.stores],
+        cc=[c.commit_count for c in o.ctxs],
+        lvr=[n.latest_voted_round for n in o.nxs],
+        lock=[n.locked_round for n in o.nxs],
+        tt=list(o.timer_time), ts=list(o.timer_stamp),
+        qvalid=len(live),
+        qtimes=sorted(m.time for m in live),
+        qkinds=sorted(m.kind for m in live),
+        qstamps=sorted(m.stamp for m in live),
+        sent=o.n_msgs_sent, dropped=o.n_msgs_dropped, full=o.n_queue_full,
+        pm_round=[pm.active_round for pm in o.pms],
+    )
+
+
+def main():
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    max_ev = int(sys.argv[2]) if len(sys.argv) > 2 else 900
+    p = SimParams(n_nodes=3, max_clock=1000)
+    delay_table = jnp.asarray(p.delay_table())
+    dur_table = jnp.asarray(p.duration_table())
+    step = jax.jit(functools.partial(S.step, p, delay_table, dur_table))
+    st = S.init_state(p, seed)
+    orc = OracleSim(p, seed)
+    a, b = snap_jax(st), snap_orc(orc)
+    assert a == b, f"init mismatch: { {k: (a[k], b[k]) for k in a if a[k] != b[k]} }"
+    for i in range(max_ev):
+        st = step(st)
+        orc.step()
+        a, b = snap_jax(st), snap_orc(orc)
+        if a != b:
+            print(f"DIVERGED at event {i + 1}")
+            for k in a:
+                if a[k] != b[k]:
+                    print(f"  {k}: jax={a[k]} oracle={b[k]}")
+            return
+        if a["halted"]:
+            print(f"both halted at event {i + 1}, identical")
+            return
+    print(f"no divergence in {max_ev} events")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""DataWriter, round plotter, sweeps, and the CLI."""
+
+import csv
+import json
+import os
+
+import numpy as np
+
+from librabft_simulator_tpu.analysis import round_plotter, sweeps
+from librabft_simulator_tpu.analysis.data_writer import DataWriter
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import simulator as S
+
+
+def run_traced(n=3, max_clock=500, seed=42):
+    p = SimParams(n_nodes=n, max_clock=max_clock, trace_cap=1024)
+    st = S.run_to_completion(p, S.init_state(p, seed))
+    return p, st
+
+
+def test_data_writer_outputs(tmp_path):
+    p, st = run_traced()
+    summary = DataWriter(p, str(tmp_path)).write(st)
+    with open(tmp_path / "round_switches.txt") as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["node 0", "node 1", "node 2"]
+    assert len(rows) - 1 == summary["max_round"] + 1
+    # Round-switch times are monotone per node where present.
+    for node in range(3):
+        times = [int(r[node]) for r in rows[1:] if r[node] != ""]
+        assert times == sorted(times)
+        assert len(times) > 3
+    with open(tmp_path / "number_of_messages.txt") as f:
+        assert int(f.read().strip()) == summary["n_msgs_sent"] > 0
+    with open(tmp_path / "summary.json") as f:
+        assert json.load(f)["n_events"] == summary["n_events"]
+
+
+def test_round_plotter_ascii_and_png(tmp_path, capsys):
+    p, st = run_traced()
+    DataWriter(p, str(tmp_path)).write(st)
+    csv_path = str(tmp_path / "round_switches.txt")
+    round_plotter.main([csv_path, "--ascii"])
+    out = capsys.readouterr().out
+    assert "round" in out
+    png = str(tmp_path / "plot.png")
+    round_plotter.main([csv_path, "--out", png])
+    assert os.path.getsize(png) > 0
+
+
+def test_sweep_single_config():
+    p = SimParams(n_nodes=3, max_clock=400)
+    res = sweeps.run_config(p, n_instances=4)
+    assert res["instances"] == 4
+    assert res["total_commits"] > 0
+    assert res["rounds_per_sec"] > 0
+
+
+def test_cli_main_json(capsys):
+    from librabft_simulator_tpu.main import main
+
+    summary = main(["--nodes", "3", "--max_clock", "400", "--seed", "5",
+                    "--instances", "2", "--json"])
+    assert summary["instances"] == 2
+    assert summary["mean_commits_per_node"] > 0
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1])["seed"] == 5
+
+
+def test_cli_writes_data_files(tmp_path):
+    from librabft_simulator_tpu.main import main
+
+    main(["--nodes", "3", "--max_clock", "400", "--seed", "5",
+          "--output_data_files", str(tmp_path)])
+    assert (tmp_path / "round_switches.txt").exists()
+    assert (tmp_path / "summary.json").exists()

@@ -1,0 +1,69 @@
+"""Safety under Byzantine faults (BASELINE config #4):
+no conflicting commits among honest nodes for f <= floor((n-1)/3).
+
+Liveness notes: the leader schedule is a fixed pseudorandom sequence
+(config.leader_of_round), so a faulty author stalls exactly the rounds it
+leads.  For n=4, author 3 first leads at round 13 — making IT faulty keeps
+early rounds honest-led, which lets liveness assertions run at short clocks.
+Author 0 leads rounds 2,5,7,8,9,10,12, so making it faulty defers commits
+past clock ~10k: those configs assert safety only.
+"""
+
+import numpy as np
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import byzantine as B
+from librabft_simulator_tpu.sim import simulator as S
+
+
+def run_fleet(p, n_inst, f, kind, authors=None):
+    seeds = np.arange(n_inst, dtype=np.uint32)
+    st = B.init_fault_batch(p, seeds, f=f, kind=kind, authors=authors)
+    return S.run_to_completion(p, st, batched=True, max_chunks=400)
+
+
+def test_equivocator_within_threshold_safe_and_live():
+    p = SimParams(n_nodes=4, max_clock=1500)
+    st = run_fleet(p, 24, f=1, kind="equivocate", authors=[3])
+    honest = np.arange(4) != 3
+    safe = B.check_safety(st, honest)
+    assert safe.all(), f"{(~safe).sum()} unsafe instances"
+    cc = np.asarray(st.ctx.commit_count)[:, honest]
+    assert (cc.max(axis=1) > 0).mean() > 0.8
+
+
+def test_equivocator_bad_schedule_still_safe():
+    # Author 0 equivocating blocks early commit windows: liveness is deferred
+    # but safety must be unconditional.
+    p = SimParams(n_nodes=4, max_clock=3000)
+    st = run_fleet(p, 16, f=1, kind="equivocate")  # authors=[0]
+    honest = np.arange(4) >= 1
+    assert B.check_safety(st, honest).all()
+
+
+def test_silent_node_within_threshold_safe_and_live():
+    p = SimParams(n_nodes=4, max_clock=2000)
+    st = run_fleet(p, 16, f=1, kind="silent", authors=[3])
+    honest = np.arange(4) != 3
+    assert B.check_safety(st, honest).all()
+    cc = np.asarray(st.ctx.commit_count)[:, honest]
+    assert (cc.max(axis=1) > 0).all()
+
+
+def test_f_sweep_structure():
+    p = SimParams(n_nodes=4, max_clock=800)
+    res = B.f_sweep(p, n_instances=8, f_values=[0, 1], kind="equivocate")
+    assert [r.f for r in res] == [0, 1]
+    for r in res:
+        assert r.safe_fraction == 1.0
+    assert res[0].live_fraction == 1.0
+
+
+def test_too_many_silent_loses_liveness_not_safety():
+    # f=2 of 4 silent: quorum of 3 unreachable -> no commits, but never unsafe.
+    p = SimParams(n_nodes=4, max_clock=800)
+    st = run_fleet(p, 8, f=2, kind="silent")
+    honest = np.arange(4) >= 2
+    assert B.check_safety(st, honest).all()
+    cc = np.asarray(st.ctx.commit_count)[:, honest]
+    assert (cc == 0).all()

@@ -1,0 +1,50 @@
+"""Mirrors /root/reference/bft-lib/src/unit_tests/configuration_tests.rs."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from librabft_simulator_tpu.core import config
+
+
+def test_count():
+    weights = jnp.asarray([1, 2, 3], jnp.int32)
+    assert int(config.total_votes(weights)) == 6
+    mask1 = jnp.asarray([False, True, False])
+    assert int(config.count_votes(weights, mask1)) == 2
+    mask_none = jnp.asarray([False, False, False])
+    assert int(config.count_votes(weights, mask_none)) == 0
+
+
+def test_pick_author_weighted_hits():
+    # Over total_votes consecutive residues, each author is hit in proportion
+    # to its weight (configuration_tests.rs::test_pick_author).
+    weights = jnp.asarray([1, 2, 5], jnp.int32)
+    hits = {}
+    for seed in range(20, 20 + 8):
+        a = int(config.pick_author(weights, jnp.uint32(seed)))
+        hits[a] = hits.get(a, 0) + 1
+    assert sorted(hits.values()) == [1, 2, 5]
+
+
+def test_quorum_thresholds():
+    for n, expect in [(1, 1), (2, 2), (3, 3), (4, 3), (5, 4), (6, 5)]:
+        w = jnp.ones((n,), jnp.int32)
+        assert int(config.quorum_threshold(w)) == expect
+
+
+def test_validity_thresholds():
+    # (N + 2) / 3 (configuration.rs:58-62): f+1 for N = 3f+1.
+    for n, expect in [(1, 1), (2, 1), (3, 1), (4, 2), (5, 2), (6, 2), (7, 3)]:
+        w = jnp.ones((n,), jnp.int32)
+        assert int(config.validity_threshold(w)) == expect
+
+
+def test_leader_of_round_is_deterministic_and_weighted():
+    w = jnp.asarray([0, 0, 7], jnp.int32)
+    for r in range(1, 10):
+        assert int(config.leader_of_round(w, r)) == 2  # only author with weight
+    w2 = jnp.ones((4,), jnp.int32)
+    leaders = {int(config.leader_of_round(w2, r)) for r in range(1, 40)}
+    assert leaders == {0, 1, 2, 3}  # every author leads eventually
+    a = int(config.leader_of_round(w2, 5))
+    assert a == int(config.leader_of_round(w2, 5))

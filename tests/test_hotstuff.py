@@ -1,0 +1,78 @@
+"""Two-chain HotStuff-style commit rule (BASELINE config #5): the protocol
+plug-in surface of the C-chain generalization (core/store.py
+update_commit_chain / vote_committed_state with commit_chain=2)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from librabft_simulator_tpu.core import config, store as store_ops
+from librabft_simulator_tpu.core.types import SimParams, Store
+from librabft_simulator_tpu.sim import simulator as S
+from tests.test_simulator import assert_safety
+
+
+def make_round(p, s, w, time):
+    leader = int(config.leader_of_round(w, s.current_round))
+    r, t = store_ops.hqc_ref(p, s)
+    s, ok = store_ops.propose_block(p, s, w, leader, r, t, time, int(time))
+    assert bool(ok)
+    var = int(s.proposed_var)
+    for a in range(int(config.quorum_threshold(w))):
+        s, ok = store_ops.create_vote(p, s, w, a, s.current_round, var)
+    s, created = store_ops.check_new_qc(p, s, w, leader)
+    assert bool(created)
+    return s
+
+
+def test_two_chain_commits_one_round_earlier():
+    # With C=2, two contiguous QCs commit; with C=3 it takes three.
+    w = jnp.ones((2,), jnp.int32)
+    p2 = SimParams(n_nodes=2, commit_chain=2)
+    s = Store.initial(p2)
+    s = make_round(p2, s, w, 10)
+    assert int(s.hcr) == 0
+    s = make_round(p2, s, w, 20)
+    assert int(s.hcr) == 1  # rounds 1,2 contiguous -> round 1 commits
+    p3 = SimParams(n_nodes=2, commit_chain=3)
+    s3 = Store.initial(p3)
+    s3 = make_round(p3, s3, w, 10)
+    s3 = make_round(p3, s3, w, 20)
+    assert int(s3.hcr) == 0  # 3-chain still needs one more
+
+
+def test_two_chain_requires_contiguity():
+    w = jnp.ones((2,), jnp.int32)
+    p = SimParams(n_nodes=2, commit_chain=2)
+    s = Store.initial(p)
+    s = make_round(p, s, w, 10)
+    assert int(s.hcr) == 0  # a lone QC commits nothing even under 2-chain
+    # Force a TC gap: rounds no longer contiguous.
+    for a in range(2):
+        s, _ = store_ops.create_timeout(p, s, w, a, s.current_round)
+    s = make_round(p, s, w, 30)
+    assert int(s.hcr) == 0  # QC3 chains to QC1: non-contiguous, no commit
+    s = make_round(p, s, w, 40)
+    assert int(s.hcr) == 3  # QC3+QC4 contiguous -> round 3 commits
+
+
+def test_end_to_end_hotstuff_16_nodes():
+    # BASELINE config #5 shape (instances shrunk for CI).
+    import jax
+
+    p = SimParams(n_nodes=16, max_clock=1500, commit_chain=2, queue_cap=256)
+    st = S.run_to_completion(p, S.init_batch(p, np.arange(4, dtype=np.uint32)),
+                             batched=True)
+    cc = np.asarray(st.ctx.commit_count)
+    assert (cc.max(axis=1) > 0).mean() >= 0.75
+    for b in range(4):
+        assert_safety(jax.tree.map(lambda x: x[b], st), 16)
+
+
+def test_two_chain_commits_faster_end_to_end():
+    p2 = SimParams(n_nodes=3, max_clock=800, commit_chain=2)
+    p3 = SimParams(n_nodes=3, max_clock=800, commit_chain=3)
+    st2 = S.run_to_completion(p2, S.init_state(p2, 21))
+    st3 = S.run_to_completion(p3, S.init_state(p3, 21))
+    # Same trajectory of rounds; the 2-chain rule can only commit earlier.
+    assert int(np.asarray(st2.ctx.commit_count).min()) >= \
+        int(np.asarray(st3.ctx.commit_count).min())

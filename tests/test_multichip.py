@@ -1,0 +1,41 @@
+"""Multi-chip: sharded run equals unsharded run on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.parallel import mesh as mesh_ops
+from librabft_simulator_tpu.parallel import sharded
+from librabft_simulator_tpu.sim import simulator as S
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return mesh_ops.make_mesh(n_dp=4, n_mp=2)
+
+
+def test_sharded_equals_unsharded(mesh):
+    p = SimParams(n_nodes=3, max_clock=300)
+    seeds = np.arange(16, dtype=np.uint32)
+    ref = S.run_to_completion(p, S.init_batch(p, seeds), batched=True)
+    st = sharded.run_sharded(p, mesh, S.init_batch(p, seeds), num_steps=512 * 200)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(st)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shard_placement(mesh):
+    p = SimParams(n_nodes=3)
+    st = mesh_ops.shard_batch(mesh, S.init_batch(p, np.arange(8, dtype=np.uint32)))
+    assert len(st.clock.sharding.device_set) == 8
+
+
+def test_mp_quorum_psum(mesh):
+    w = jnp.ones((16,), jnp.int32)
+    mask = jnp.arange(16) < 11
+    assert int(sharded.sharded_count_votes(mesh, w, mask)) == 11
+    assert bool(sharded.sharded_quorum_reached(mesh, w, mask))
+    mask2 = jnp.arange(16) < 10
+    assert not bool(sharded.sharded_quorum_reached(mesh, w, mask2))

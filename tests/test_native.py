@@ -1,0 +1,78 @@
+"""Native C++ engine parity vs the Python oracle (and transitively the JAX
+path, via tests/test_parity.py)."""
+
+import numpy as np
+import pytest
+
+from librabft_simulator_tpu import native
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.oracle.sim import OracleSim
+
+
+def assert_native_matches_oracle(p, seed, **kw):
+    res = native.run(p, seed, **kw)
+    orc_kw = {
+        {"byz_equivocate": "byz_equivocate", "byz_silent": "byz_silent",
+         "weights": "weights"}[k]: np.asarray(v).tolist() for k, v in kw.items()
+    }
+    orc = OracleSim(p, seed, **orc_kw).run()
+    assert res.n_events == orc.n_events
+    assert res.clock == orc.clock
+    assert res.stamp_ctr == orc.stamp_ctr
+    assert res.n_msgs_sent == orc.n_msgs_sent
+    assert res.n_msgs_dropped == orc.n_msgs_dropped
+    assert res.n_queue_full == orc.n_queue_full
+    for a in range(p.n_nodes):
+        assert res.committed_chain(a) == orc.committed_chain(a), f"node {a}"
+        assert res.current_round(a) == orc.stores[a].current_round
+        assert res.hqc_round(a) == orc.stores[a].hqc_round
+        assert res.hcr(a) == orc.stores[a].hcr
+    return res, orc
+
+
+def test_build():
+    assert native.build()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 42])
+def test_native_parity_3node(seed):
+    p = SimParams(n_nodes=3, max_clock=1000)
+    res, orc = assert_native_matches_oracle(p, seed)
+    assert res.commit_count(0) > 0
+
+
+def test_native_parity_drop_pareto():
+    p = SimParams(n_nodes=4, max_clock=1500, delay_kind="pareto", drop_prob=0.05)
+    assert_native_matches_oracle(p, 7)
+
+
+def test_native_parity_weighted():
+    p = SimParams(n_nodes=4, max_clock=800)
+    assert_native_matches_oracle(p, 3, weights=np.asarray([1, 2, 3, 1], np.int32))
+
+
+def test_native_parity_byzantine():
+    p = SimParams(n_nodes=4, max_clock=1000)
+    assert_native_matches_oracle(
+        p, 13, byz_equivocate=np.asarray([0, 0, 0, 1], np.uint8))
+    assert_native_matches_oracle(
+        p, 17, byz_silent=np.asarray([0, 0, 0, 1], np.uint8))
+
+
+def test_native_parity_hotstuff():
+    p = SimParams(n_nodes=3, max_clock=800, commit_chain=2)
+    res, _ = assert_native_matches_oracle(p, 11)
+    assert res.commit_count(0) > 0
+
+
+def test_native_speed_smoke():
+    # The native engine exists to be fast on host: a long run finishes quickly.
+    import time
+
+    p = SimParams(n_nodes=3, max_clock=100000, target_commit_interval=1000)
+    t0 = time.perf_counter()
+    res = native.run(p, 5)
+    dt = time.perf_counter() - t0
+    assert res.halted
+    assert res.n_events > 10000
+    assert dt < 10.0

@@ -1,0 +1,106 @@
+"""NodeState update_node loop
+(/root/reference/librabft-v2/src/unit_tests/node_tests.rs + node.rs:240-304)."""
+
+import jax
+import jax.numpy as jnp
+
+from librabft_simulator_tpu.core import config, node as node_ops, store as store_ops
+from librabft_simulator_tpu.core.types import (
+    Context, NodeExtra, Pacemaker, SimParams, Store,
+)
+
+
+def slices(p, n):
+    return (
+        Store.initial(p), Pacemaker.initial(), NodeExtra.initial(),
+        Context.initial(p), jnp.ones((n,), jnp.int32),
+        jnp.asarray(p.duration_table()),
+    )
+
+
+def test_initial_state_roundtrip():
+    # make_initial_state / save / load equality (node_tests.rs:16-21) maps to
+    # pytree equality of freshly built state.
+    p = SimParams(n_nodes=1)
+    s0 = Store.initial(p)
+    s1 = Store.initial(p)
+    for a, b in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+        assert (a == b).all()
+
+
+def test_single_node_progresses_and_commits():
+    # n=1: quorum of 1, the node proposes, votes, mints QCs and commits alone.
+    p = SimParams(n_nodes=1)
+    s, pm, nx, cx, w, dur = slices(p, 1)
+    clock = 0
+    for _ in range(8):
+        s, pm, nx, cx, act = node_ops.update_node(p, s, pm, nx, cx, w, 0, clock, dur)
+        clock = max(clock + 1, int(act.next_sched))
+    assert int(s.hqc_round) >= 3
+    assert int(s.hcr) >= 1
+    assert int(cx.commit_count) >= 1
+    # Committed depths are the 1,2,3,... chain of executed commands.
+    depths = [int(cx.log_depth[i]) for i in range(int(cx.commit_count))]
+    assert depths == list(range(1, len(depths) + 1))
+
+
+def test_insert_block_qc_updates_hqc():
+    # node_tests.rs:24-76: handcrafted block + QC insert moves the hqc.
+    p = SimParams(n_nodes=1)
+    s, pm, nx, cx, w, dur = slices(p, 1)
+    b = store_ops.make_block_msg(p, s, 0, jnp.int32(0), s.initial_tag, 1, 0, 0)
+    s, ok = store_ops.insert_block(p, s, w, b, s.epoch_id)
+    assert bool(ok)
+    s2, ok = store_ops.create_vote(p, s, w, 0, s.current_round, 0)
+    assert bool(ok)
+    s3, created = store_ops.check_new_qc(p, s2, w, 0)
+    assert bool(created)
+    assert int(s3.hqc_round) == 1
+    _, hqc_tag = store_ops.hqc_ref(p, s3)
+    assert int(hqc_tag) != int(s3.initial_tag)
+
+
+def test_voting_rules_lock_and_latest_voted():
+    p = SimParams(n_nodes=3)
+    s, pm, nx, cx, w, dur = slices(p, 3)
+    author = int(config.leader_of_round(w, 1))
+    s, pm, nx, cx, act = node_ops.update_node(p, s, pm, nx, cx, w, author, 0, dur)
+    # Leader proposed at round 1 and voted for its own proposal.
+    assert int(s.proposed_var) >= 0
+    assert int(nx.latest_voted_round) == 1
+    assert bool(s.vt_valid[author])
+    # The vote goes to the proposer; a second update must not re-vote.
+    nx_before = int(nx.latest_voted_round)
+    s, pm, nx, cx, act = node_ops.update_node(p, s, pm, nx, cx, w, author, 1, dur)
+    assert int(nx.latest_voted_round) == nx_before
+
+
+def test_timeout_blocks_vote_at_that_round():
+    p = SimParams(n_nodes=3, delta=5, gamma=1.0)
+    s, pm, nx, cx, w, dur = slices(p, 3)
+    leader = int(config.leader_of_round(w, 1))
+    other = (leader + 1) % 3
+    # First update enters round 1 (round_start = clock); the second, past the
+    # deadline, creates a timeout.
+    s, pm, nx, cx, act = node_ops.update_node(p, s, pm, nx, cx, w, other, 100, dur)
+    assert not bool(s.to_valid[other])
+    deadline = int(act.next_sched)
+    s, pm, nx, cx, act = node_ops.update_node(p, s, pm, nx, cx, w, other, deadline, dur)
+    assert bool(s.to_valid[other])
+    assert int(nx.latest_voted_round) >= 1  # never vote at a timed-out round
+
+
+def test_epoch_switch_resets_store():
+    # commands_per_epoch=2: after committing depth 2, the node switches epoch.
+    p = SimParams(n_nodes=1, commands_per_epoch=2)
+    s, pm, nx, cx, w, dur = slices(p, 1)
+    clock = 0
+    for _ in range(12):
+        s, pm, nx, cx, act = node_ops.update_node(p, s, pm, nx, cx, w, 0, clock, dur)
+        clock = max(clock + 1, int(act.next_sched))
+        if int(s.epoch_id) >= 1:
+            break
+    assert int(s.epoch_id) >= 1
+    assert int(nx.locked_round) == 0
+    assert int(s.initial_state_depth) >= 2
+    assert int(cx.commit_count) >= 2

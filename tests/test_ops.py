@@ -1,0 +1,40 @@
+"""Pallas event-select kernel == plain-XLA reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from librabft_simulator_tpu.ops.pallas_queue import (
+    NEVER, select_events, select_events_reference,
+)
+
+
+def random_batch(rng, B, M, max_t=100):
+    times = rng.integers(0, max_t, (B, M)).astype(np.int32)
+    invalid = rng.random((B, M)) < 0.3
+    times = np.where(invalid, NEVER, times)
+    kinds = rng.integers(0, 4, (B, M)).astype(np.int32)
+    # Unique stamps per row (the simulator guarantees this).
+    stamps = np.argsort(rng.random((B, M))).astype(np.int32)
+    return jnp.asarray(times), jnp.asarray(kinds), jnp.asarray(stamps)
+
+
+@pytest.mark.parametrize("shape", [(4, 35), (8, 128), (3, 200)])
+def test_select_matches_reference(shape):
+    rng = np.random.default_rng(0)
+    B, M = shape
+    t, k, s = random_batch(rng, B, M)
+    idx_p, tmin_p = select_events(t, k, s, interpret=True)
+    idx_r, tmin_r = select_events_reference(t, k, s)
+    np.testing.assert_array_equal(np.asarray(idx_p), np.asarray(idx_r))
+    np.testing.assert_array_equal(np.asarray(tmin_p), np.asarray(tmin_r))
+
+
+def test_ties_resolved_lexicographically():
+    # Equal times: higher kind wins; equal kind: lower stamp; then lowest col.
+    t = jnp.asarray([[5, 5, 5, 9]], jnp.int32)
+    k = jnp.asarray([[1, 3, 3, 3]], jnp.int32)
+    s = jnp.asarray([[0, 7, 2, 1]], jnp.int32)
+    idx, tmin = select_events(t, k, s, interpret=True)
+    assert int(idx[0]) == 2 and int(tmin[0]) == 5

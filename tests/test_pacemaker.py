@@ -1,0 +1,81 @@
+"""Pacemaker: round entry, leader stability, duration growth, timeouts,
+query-all (/root/reference/librabft-v2/src/pacemaker.rs)."""
+
+import jax.numpy as jnp
+
+from librabft_simulator_tpu.core import config, pacemaker as pm_ops, store as store_ops
+from librabft_simulator_tpu.core.types import NEVER, Pacemaker, SimParams, Store
+
+
+def mk(n=3, **kw):
+    p = SimParams(n_nodes=n, **kw)
+    return p, jnp.ones((n,), jnp.int32), Store.initial(p), Pacemaker.initial(), \
+        jnp.asarray(p.duration_table())
+
+
+def test_duration_table_growth():
+    p = SimParams(delta=20, gamma=2.0)
+    tbl = p.duration_table()
+    assert tbl[0] == 0 and tbl[1] == 20 and tbl[2] == 80 and tbl[3] == 180
+    assert all(tbl[i] <= tbl[i + 1] for i in range(len(tbl) - 1))
+
+
+def test_enter_round_and_leader():
+    p, w, s, pm, dur = mk()
+    author = int(config.leader_of_round(w, 1))
+    pm2, a = pm_ops.update_pacemaker(p, pm, s, w, author, 0, 0, 0, dur)
+    assert int(pm2.active_round) == 1
+    assert int(pm2.active_leader) == author
+    assert bool(a.should_propose) and bool(a.should_broadcast)
+    assert int(a.propose_prev_round) == 0
+    # Re-entering the same round keeps leader/duration (stability).
+    pm3, _ = pm_ops.update_pacemaker(p, pm2, s, w, author, 0, 0, 5, dur)
+    assert int(pm3.active_leader) == author
+    assert int(pm3.round_start) == int(pm2.round_start)
+
+
+def test_non_leader_syncs_with_leader():
+    p, w, s, pm, dur = mk()
+    leader = int(config.leader_of_round(w, 1))
+    other = (leader + 1) % p.n_nodes
+    pm2, a = pm_ops.update_pacemaker(p, pm, s, w, other, 0, 0, 0, dur)
+    assert not bool(a.should_propose)
+    assert int(a.send_leader) == leader
+
+
+def test_timeout_at_deadline():
+    p, w, s, pm, dur = mk(delta=20, gamma=2.0)
+    leader = int(config.leader_of_round(w, 1))
+    other = (leader + 1) % p.n_nodes
+    pm2, a = pm_ops.update_pacemaker(p, pm, s, w, other, 0, 0, 0, dur)
+    deadline = int(pm2.round_start + pm2.round_duration)
+    assert not bool(a.should_create_timeout)
+    assert int(a.next_sched) == deadline
+    # At the deadline: create a timeout and broadcast it.
+    pm3, a2 = pm_ops.update_pacemaker(p, pm2, s, w, other, 0, 0, deadline, dur)
+    assert bool(a2.should_create_timeout)
+    assert int(a2.timeout_round) == 1
+    assert bool(a2.should_broadcast)
+
+
+def test_query_all_period_after_timeout():
+    p, w, s, pm, dur = mk(delta=20, gamma=2.0)
+    author = 0
+    s2, ok = store_ops.create_timeout(p, s, w, author, 1)
+    assert bool(ok)
+    pm2, a = pm_ops.update_pacemaker(p, pm, s2, w, author, 0, 0, 1000, dur)
+    # Holding a timeout past the deadline: no new timeout; periodic query-all.
+    assert not bool(a.should_create_timeout)
+    assert bool(a.should_query_all)  # latest_query_all=0 is long past
+    period = (p.lam_fp * int(pm2.round_duration)) >> 16
+    pm3, a2 = pm_ops.update_pacemaker(p, pm2, s2, w, author, 0, 1000, 1000, dur)
+    assert not bool(a2.should_query_all)
+    assert int(a2.next_sched) == 1000 + period
+
+
+def test_round_advances_with_hqc_htc():
+    p, w, s, pm, dur = mk()
+    s = s.replace(hqc_round=jnp.int32(4), htc_round=jnp.int32(6))
+    s = store_ops.update_current_round(s, 7)
+    pm2, _ = pm_ops.update_pacemaker(p, pm, s, w, 0, 0, 0, 50, dur)
+    assert int(pm2.active_round) == 7  # max(hqc, htc) + 1

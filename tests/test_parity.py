@@ -1,0 +1,101 @@
+"""North-star parity: the jitted JAX trajectory is bit-identical to the
+pure-Python oracle (BASELINE.json: 'commit sequences byte-identical')."""
+
+import jax
+import numpy as np
+import pytest
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.oracle.sim import OracleSim
+from librabft_simulator_tpu.sim import simulator as S
+
+
+def jax_run(p, seed, **init_kw):
+    st = S.init_state(p, seed, **init_kw)
+    return S.run_to_completion(p, st)
+
+
+def jax_committed_chain(st, node):
+    cc = int(st.ctx.commit_count[node])
+    H = st.ctx.log_depth.shape[-1]
+    out = []
+    for i in range(max(cc - H, 0), cc):
+        pos = i % H
+        out.append((int(st.ctx.log_depth[node, pos]), int(st.ctx.log_tag[node, pos])))
+    return out
+
+
+def assert_parity(p, seed, **init_kw):
+    st = jax_run(p, seed, **init_kw)
+    orc_kw = {k: np.asarray(v).tolist() for k, v in init_kw.items()}
+    orc = OracleSim(p, seed, **orc_kw).run()
+    assert int(st.n_events) == orc.n_events
+    assert int(st.clock) == orc.clock
+    assert int(st.stamp_ctr) == orc.stamp_ctr
+    assert int(st.n_msgs_sent) == orc.n_msgs_sent
+    assert int(st.n_msgs_dropped) == orc.n_msgs_dropped
+    assert int(st.n_queue_full) == orc.n_queue_full
+    for a in range(p.n_nodes):
+        assert jax_committed_chain(st, a) == orc.committed_chain(a), f"node {a}"
+        assert int(st.ctx.last_depth[a]) == orc.ctxs[a].last_depth
+        assert int(st.ctx.last_tag[a]) == orc.ctxs[a].last_tag
+        assert int(st.store.current_round[a]) == orc.stores[a].current_round
+        assert int(st.store.hqc_round[a]) == orc.stores[a].hqc_round
+        assert int(st.store.hcr[a]) == orc.stores[a].hcr
+        assert int(st.node.locked_round[a]) == orc.nxs[a].locked_round
+    return st, orc
+
+
+@pytest.mark.parametrize("seed", [0, 1, 42])
+def test_parity_default_3node(seed):
+    p = SimParams(n_nodes=3, max_clock=1000)
+    st, orc = assert_parity(p, seed)
+    assert min(int(c) for c in st.ctx.commit_count) > 0  # non-trivial
+
+
+def test_parity_4node_uniform():
+    p = SimParams(n_nodes=4, max_clock=800, delay_kind="uniform")
+    assert_parity(p, 7)
+
+
+def test_parity_drop_and_pareto():
+    p = SimParams(n_nodes=3, max_clock=1500, delay_kind="pareto", drop_prob=0.05)
+    st, orc = assert_parity(p, 5)
+    assert orc.n_msgs_dropped > 0
+
+
+def test_parity_weighted_authors():
+    p = SimParams(n_nodes=4, max_clock=800)
+    assert_parity(p, 3, weights=np.asarray([1, 2, 3, 1], np.int32))
+
+
+def test_parity_hotstuff_2chain():
+    p = SimParams(n_nodes=3, max_clock=800, commit_chain=2)
+    st, orc = assert_parity(p, 11)
+    assert min(int(c) for c in st.ctx.commit_count) > 0
+
+
+def test_parity_byzantine_silent():
+    p = SimParams(n_nodes=4, max_clock=1000)
+    silent = np.asarray([False, False, False, True])
+    assert_parity(p, 13, byz_silent=silent)
+
+
+def test_parity_byzantine_equivocate():
+    p = SimParams(n_nodes=4, max_clock=1000)
+    eq = np.asarray([True, False, False, False])
+    assert_parity(p, 17, byz_equivocate=eq)
+
+
+def test_parity_small_window_forces_jumps():
+    p = SimParams(n_nodes=3, max_clock=2000, window=8, chain_k=2, drop_prob=0.1)
+    st, orc = assert_parity(p, 19)
+
+
+def test_parity_long_stall_wide_durations():
+    # Heavy drop keeps commits rare, so round durations (delta * n^gamma) grow
+    # past 2^16 — the regime where the 16.16 query-all product would overflow
+    # int32 if computed naively (core/pacemaker.py saturating arithmetic).
+    p = SimParams(n_nodes=4, max_clock=3_000_000, drop_prob=0.5, gamma=4.0)
+    st, orc = assert_parity(p, 23)
+    assert max(o.round_duration for o in orc.pms) > 65536

@@ -1,0 +1,206 @@
+"""Mirrors /root/reference/librabft-v2/src/unit_tests/record_store_tests.rs
+on the tensorized store (single-node slice, no vmap)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from librabft_simulator_tpu.core import config, store as store_ops
+from librabft_simulator_tpu.core.types import ELECTION_WON, SimParams, Store
+
+
+class SharedStore:
+    """Test double for SharedRecordStore (record_store_tests.rs:8-104):
+    one store written to by several authors."""
+
+    def __init__(self, n=2, window=16):
+        self.p = SimParams(n_nodes=n, window=window)
+        self.w = jnp.ones((n,), jnp.int32)
+        self.s = Store.initial(self.p)
+
+    # -- helpers mirroring the Rust test harness --------------------------
+    def propose(self, author, time, prev=None):
+        prev_r, prev_t = prev if prev is not None else store_ops.hqc_ref(self.p, self.s)
+        self.s, ok = store_ops.propose_block(
+            self.p, self.s, self.w, author, prev_r, prev_t, time,
+            cmd_index=int(time),
+        )
+        return bool(ok)
+
+    def vote(self, author, var):
+        self.s, ok = store_ops.create_vote(
+            self.p, self.s, self.w, author, self.s.current_round, var
+        )
+        return bool(ok)
+
+    def timeout(self, author, round_):
+        self.s, ok = store_ops.create_timeout(self.p, self.s, self.w, author, round_)
+        return bool(ok)
+
+    def check_qc(self, author):
+        self.s, created = store_ops.check_new_qc(self.p, self.s, self.w, author)
+        return bool(created)
+
+    def leader(self):
+        return int(config.leader_of_round(self.w, self.s.current_round))
+
+    def make_round(self, time):
+        leader = self.leader()
+        assert self.propose(leader, time)
+        var = int(self.s.proposed_var)
+        thresh = int(config.quorum_threshold(self.w))
+        for a in range(thresh):
+            assert self.vote(a, var)
+        assert self.check_qc(leader)
+
+    def make_tc(self):
+        thresh = int(config.quorum_threshold(self.w))
+        r = int(self.s.current_round)
+        for a in range(thresh):
+            self.timeout(a, r)
+
+    # -- observations -----------------------------------------------------
+    def n_blocks(self):
+        return int(jnp.sum(self.s.blk_valid))
+
+    def n_qcs(self):
+        return int(jnp.sum(self.s.qc_valid))
+
+    def n_timeouts(self):
+        return int(jnp.sum(self.s.to_valid))
+
+    def snapshot(self):
+        s = self.s
+        return dict(
+            hqc_round=int(s.hqc_round), htc_round=int(s.htc_round),
+            hcr=int(s.hcr), current_round=int(s.current_round),
+        )
+
+
+def test_initial_store():
+    st = SharedStore(2)
+    assert st.n_blocks() == 0 and st.n_qcs() == 0 and st.n_timeouts() == 0
+    assert st.snapshot() == dict(hqc_round=0, htc_round=0, hcr=0, current_round=1)
+    r, t = store_ops.hqc_ref(st.p, st.s)
+    assert int(r) == 0 and int(t) == int(st.s.initial_tag)
+
+
+def test_propose_and_vote_no_qc():
+    st = SharedStore(2)
+    assert st.propose(0, 1, prev=(jnp.int32(0), st.s.initial_tag))
+    assert st.propose(1, 2, prev=(jnp.int32(0), st.s.initial_tag))
+    assert st.n_blocks() == 2
+    assert st.vote(0, 0)
+    assert not st.vote(0, 0)  # one vote per author
+    assert st.vote(1, 1)      # a vote for the *other* block
+    leader = st.leader()
+    assert not st.check_qc(leader)
+    assert st.n_qcs() == 0
+    assert st.snapshot() == dict(hqc_round=0, htc_round=0, hcr=0, current_round=1)
+
+
+def test_vote_with_quorum():
+    st = SharedStore(2)
+    assert st.propose(0, 1)
+    assert st.propose(1, 2)
+    var = int(st.s.proposed_var)  # the legitimate leader's proposal
+    assert var >= 0
+    assert st.vote(0, var)
+    assert st.vote(1, var)
+    assert int(st.s.election) == ELECTION_WON
+    assert st.check_qc(st.leader())
+    assert st.n_blocks() == 2 and st.n_qcs() == 1
+    assert st.snapshot() == dict(hqc_round=1, htc_round=0, hcr=0, current_round=2)
+
+
+def test_timeouts_no_tc():
+    st = SharedStore(2)
+    assert st.propose(1, 2)
+    assert st.timeout(0, 1)
+    assert not st.timeout(0, 1)  # one timeout per author
+    assert not st.timeout(1, 0)  # wrong round
+    assert st.n_blocks() == 1 and st.n_qcs() == 0 and st.n_timeouts() == 1
+    assert st.snapshot() == dict(hqc_round=0, htc_round=0, hcr=0, current_round=1)
+
+
+def test_timeouts_with_tc():
+    st = SharedStore(2)
+    assert st.propose(1, 2)
+    assert not st.timeout(1, 0)  # ignored: stale round
+    assert st.timeout(0, 1)
+    assert st.timeout(1, 1)      # completes the TC -> round 2
+    assert st.timeout(1, 2)      # single timeout at the new round
+    assert st.n_blocks() == 1 and st.n_qcs() == 0
+    snap = st.snapshot()
+    assert snap["htc_round"] == 1 and snap["current_round"] == 2
+    assert st.n_timeouts() == 1
+    assert st.timeout(0, 2)      # completes the next TC
+    snap = st.snapshot()
+    assert snap["htc_round"] == 2 and snap["current_round"] == 3
+    assert st.n_timeouts() == 0
+
+
+def test_non_contiguous_qcs():
+    st = SharedStore(2)
+    st.make_round(10)
+    st.make_round(20)
+    st.make_tc()
+    st.make_round(40)
+    assert st.n_blocks() == 3 and st.n_qcs() == 3
+    assert st.snapshot() == dict(hqc_round=4, htc_round=3, hcr=0, current_round=5)
+    assert st.n_timeouts() == 0
+
+
+def test_commit_3chain():
+    st = SharedStore(2)
+    st.make_round(10)
+    st.make_tc()
+    st.make_round(30)
+    st.make_round(40)
+    st.make_round(50)
+    st.make_tc()
+    assert st.n_blocks() == 4 and st.n_qcs() == 4
+    assert st.snapshot() == dict(hqc_round=5, htc_round=6, hcr=3, current_round=7)
+    assert st.n_timeouts() == 0
+    s = st.s
+    assert bool(s.hcc_valid) and int(s.hcc_round) == 5
+    # previous/second-previous rounds of the commit certificate's block
+    # (record_store_tests.rs:258-277).
+    sl = int(s.hcc_round) % st.p.window
+    bvar = s.qc_blk_var[sl, int(s.hcc_var)]
+    assert int(store_ops.previous_round(st.p, s, s.hcc_round, bvar)) == 4
+    assert int(store_ops.second_previous_round(st.p, s, s.hcc_round, bvar)) == 3
+    # committed_states_after(0) -> rounds [1, 3] (record_store_tests.rs:279-291).
+    keep, rounds, depths, tags = store_ops.committed_states_after(st.p, s, 0)
+    got = [(int(r), int(d)) for k, r, d in zip(np.asarray(keep), np.asarray(rounds),
+                                               np.asarray(depths)) if k]
+    assert [r for r, _ in got] == [1, 3]
+    assert [d for _, d in got] == [1, 2]  # one command per block on the commit chain
+
+
+def test_vote_committed_state_matches_commit_rule():
+    st = SharedStore(2)
+    st.make_round(10)
+    st.make_round(20)
+    # A QC on the round-3 proposal would form a 1-2-3 chain -> commits round 1.
+    leader = st.leader()
+    assert st.propose(leader, 30)
+    var = int(st.s.proposed_var)
+    ok, d, t = store_ops.vote_committed_state(st.p, st.s, st.s.current_round, var)
+    assert bool(ok) and int(d) == 1
+    # After a TC gap, the chain is non-contiguous -> no commit.
+    st.make_tc()
+    leader = st.leader()
+    assert st.propose(leader, 40)
+    var = int(st.s.proposed_var)
+    ok, _, _ = store_ops.vote_committed_state(st.p, st.s, st.s.current_round, var)
+    assert not bool(ok)
+
+
+def test_window_reuse_keeps_recent_rounds():
+    st = SharedStore(2, window=8)
+    for i in range(20):
+        st.make_round(10 * (i + 1))
+    # 20 rounds through a window of 8: old slots recycled, chain still commits.
+    assert st.snapshot()["hcr"] == 18
+    assert st.n_blocks() <= 8 * st.p.variants

@@ -1,0 +1,106 @@
+"""End-to-end batched simulator runs
+(/root/reference/bft-lib/src/simulator.rs + simulated_run in README)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import simulator as S
+
+
+def committed_chain(st, node):
+    """(depth, tag) pairs committed by `node`, ascending, from the ring log."""
+    cc = int(st.ctx.commit_count[node])
+    H = st.ctx.log_depth.shape[-1]
+    out = []
+    for i in range(max(cc - H, 0), cc):
+        pos = i % H
+        out.append((int(st.ctx.log_depth[node, pos]), int(st.ctx.log_tag[node, pos])))
+    return out
+
+
+def assert_safety(st, n):
+    """All nodes agree on (depth -> tag) for every depth committed by >1 node."""
+    seen = {}
+    for a in range(n):
+        for d, t in committed_chain(st, a):
+            if d in seen:
+                assert seen[d] == t, f"conflicting commit at depth {d}"
+            else:
+                seen[d] = t
+    return seen
+
+
+def test_three_nodes_commit_nontrivial_equal_histories():
+    p = SimParams(n_nodes=3, max_clock=1000)
+    st = S.init_state(p, 42)
+    st = S.run_to_completion(p, st)
+    counts = [int(c) for c in st.ctx.commit_count]
+    # Reference README run commits ~27 per 1000 time units.
+    assert min(counts) >= 15
+    assert_safety(st, 3)
+    # All nodes converged to the same last state.
+    depths = [int(d) for d in st.ctx.last_depth]
+    assert max(depths) - min(depths) <= 3
+
+
+def test_eight_nodes_commit():
+    p = SimParams(n_nodes=8, max_clock=1000, queue_cap=64)
+    st = S.init_state(p, 7)
+    st = S.run_to_completion(p, st)
+    counts = [int(c) for c in st.ctx.commit_count]
+    assert min(counts) >= 5
+    assert_safety(st, 8)
+
+
+def test_determinism_same_seed():
+    p = SimParams(n_nodes=3, max_clock=500)
+    a = S.run_to_completion(p, S.init_state(p, 123))
+    b = S.run_to_completion(p, S.init_state(p, 123))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_different_seeds_differ():
+    p = SimParams(n_nodes=3, max_clock=500)
+    a = S.run_to_completion(p, S.init_state(p, 1))
+    b = S.run_to_completion(p, S.init_state(p, 2))
+    assert int(a.n_events) != int(b.n_events) or \
+        committed_chain(a, 0) != committed_chain(b, 0)
+
+
+def test_batched_run_matches_single_runs():
+    p = SimParams(n_nodes=3, max_clock=300)
+    seeds = [5, 6, 7, 8]
+    batch = S.run_to_completion(p, S.init_batch(p, np.asarray(seeds)), batched=True)
+    for i, seed in enumerate(seeds):
+        single = S.run_to_completion(p, S.init_state(p, seed))
+        bi = jax.tree.map(lambda x: x[i], batch)
+        for x, y in zip(jax.tree.leaves(bi), jax.tree.leaves(single)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_message_drop_still_commits():
+    # BASELINE config #3 capability: liveness under 5% drop (DataSync recovers).
+    p = SimParams(n_nodes=3, max_clock=3000, drop_prob=0.05)
+    st = S.run_to_completion(p, S.init_state(p, 9))
+    assert int(st.n_msgs_dropped) > 0
+    counts = [int(c) for c in st.ctx.commit_count]
+    assert min(counts) >= 5
+    assert_safety(st, 3)
+
+
+def test_pareto_delays_commit():
+    p = SimParams(n_nodes=3, max_clock=3000, delay_kind="pareto")
+    st = S.run_to_completion(p, S.init_state(p, 11))
+    counts = [int(c) for c in st.ctx.commit_count]
+    assert min(counts) >= 1
+    assert_safety(st, 3)
+
+
+def test_clock_monotone_and_bounded():
+    p = SimParams(n_nodes=3, max_clock=400)
+    st = S.run_to_completion(p, S.init_state(p, 3))
+    assert bool(st.halted)
+    assert int(st.clock) <= 400 + 1
