@@ -163,9 +163,13 @@ def handle_notification(p: SimParams, s: Store, weights, pay: Payload):
     return s, should_sync
 
 
-def handle_request(p: SimParams, s: Store, author, req: Payload) -> Payload:
-    """data_sync.rs:183-207 with the K-tail redesign of unknown_records."""
-    resp = create_notification(p, s, author)
+def handle_request(p: SimParams, s: Store, author, req: Payload,
+                   notif: Payload | None = None) -> Payload:
+    """data_sync.rs:183-207 with the K-tail redesign of unknown_records.
+
+    ``notif`` lets callers that already built create_notification(s, author)
+    (the simulator step does) avoid retracing it."""
+    resp = notif if notif is not None else create_notification(p, s, author)
     # Walk back K QCs from our highest QC; emit ascending (blocks + QCs).
     valids, rounds, vars_, _ = store_ops.qc_walk_back(
         p, s, s.hqc_round > 0, s.hqc_round, s.hqc_var, p.chain_k
@@ -211,15 +215,19 @@ def handle_response(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         last_tag=jnp.where(adopt, pay.hcc.commit_tag, ctx.last_tag),
         sync_jumps=ctx.sync_jumps + jnp.where(do_jump, 1, 0),
     )
-    # Replay the chain tail in ascending order: block then QC.
-    for i in range(p.chain_k):
-        skip_anchor = do_jump & (jnp.asarray(i) == 0)
-        blk = jax.tree.map(lambda x: x[i], pay.chain_blk)
-        qc = jax.tree.map(lambda x: x[i], pay.chain_qc)
-        s2, _ = store_ops.insert_block(p, s, weights, blk, pay.epoch)
-        s = store_ops._sel(blk.valid & ~skip_anchor, s2, s)
-        s2, _ = store_ops.insert_qc(p, s, weights, qc)
-        s = store_ops._sel(qc.valid & ~skip_anchor, s2, s)
+    # Replay the chain tail in ascending order: block then QC.  lax.scan keeps
+    # the insert machinery traced once instead of K times (it is the single
+    # largest piece of the step graph).
+    def replay(st_, x):
+        blk, qc, skip_anchor = x
+        s2, _ = store_ops.insert_block(p, st_, weights, blk, pay.epoch)
+        st_ = store_ops._sel(blk.valid & ~skip_anchor, s2, st_)
+        s2, _ = store_ops.insert_qc(p, st_, weights, qc)
+        st_ = store_ops._sel(qc.valid & ~skip_anchor, s2, st_)
+        return st_, None
+
+    skip = do_jump & (jnp.arange(p.chain_k) == 0)
+    s, _ = jax.lax.scan(replay, s, (pay.chain_blk, pay.chain_qc, skip))
     # Highest commit certificate with its block, then the rest.
     s2, _ = store_ops.insert_block(p, s, weights, pay.hcc_blk, pay.epoch)
     s = store_ops._sel(pay.hcc_blk.valid, s2, s)

@@ -1,0 +1,380 @@
+"""Conservative-window parallel engine: N nodes per instance step together.
+
+The serial engine (:mod:`.simulator`) replays the reference's event loop one
+event at a time — the parity reference.  This engine is the throughput mode:
+classic conservative parallel discrete-event simulation (PDES) with network
+lookahead, re-expressed for TPU.
+
+Correctness argument (standard Chandy-Misra lookahead): nodes influence each
+other ONLY via messages, and every message has latency >= ``d_min`` (the
+minimum of the delay table, floored to 1 here).  Hence all events with
+timestamps in the window ``[t_min, t_min + d_min)`` at *different* nodes are
+causally independent and may be processed concurrently; same-node causality
+is preserved by processing at most one event per node per step (a node's
+events are totally ordered by (time, kind desc, stamp)).  The messages they
+emit arrive at or after ``t_min + d_min``, i.e. outside the window.
+
+TPU shape: per-receiver inboxes ``[N, IC]`` instead of one global queue; the
+whole per-node protocol machinery (data-sync handlers + update_node) runs
+under ``jax.vmap`` over the node axis — the same XLA kernels as the serial
+engine now do up to N instances' worth of useful work per launch, which is
+what makes 64-node fleets (BASELINE config #3) tractable.
+
+Determinism: rng/stamps are node-local counters (stamp stream ``ctr*N+n``),
+so trajectories are bit-reproducible for a seed (CPU == TPU), independent of
+how many nodes happen to share a window.  They are NOT the serial engine's
+trajectories (different stamp interleaving) — the serial engine remains the
+oracle-parity reference; this engine has its own determinism/safety tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core import data_sync, node as node_ops, store as store_ops
+from ..core.types import (
+    KIND_NOTIFY,
+    KIND_REQUEST,
+    KIND_RESPONSE,
+    KIND_TIMER,
+    NEVER,
+    Context,
+    NodeExtra,
+    Pacemaker,
+    SimParams,
+    Store,
+    pack_payload,
+    payload_width,
+    unpack_payload,
+)
+from ..utils import hashing as H
+from ..utils.quantile import TABLE_BITS
+
+I32 = jnp.int32
+EQUIV_SALT = 1 << 20
+
+
+def _i32(x):
+    return jnp.asarray(x, I32)
+
+
+@struct.dataclass
+class PSimState:
+    """One instance under the parallel engine."""
+
+    store: Store          # [N, ...]
+    pm: Pacemaker         # [N]
+    node: NodeExtra       # [N]
+    ctx: Context          # [N, ...]
+    # Per-receiver inboxes.
+    in_valid: jnp.ndarray    # [N, IC] bool
+    in_time: jnp.ndarray     # [N, IC]
+    in_kind: jnp.ndarray     # [N, IC]
+    in_stamp: jnp.ndarray    # [N, IC]
+    in_sender: jnp.ndarray   # [N, IC]
+    in_pay: jnp.ndarray      # [N, IC, F] packed payloads
+    timer_time: jnp.ndarray  # [N]
+    startup: jnp.ndarray     # [N]
+    weights: jnp.ndarray     # [N]
+    byz_equivocate: jnp.ndarray
+    byz_silent: jnp.ndarray
+    clock: jnp.ndarray
+    node_ctr: jnp.ndarray    # [N] per-node stamp/rng counters
+    halted: jnp.ndarray
+    seed: jnp.ndarray
+    n_events: jnp.ndarray
+    n_msgs_sent: jnp.ndarray
+    n_msgs_dropped: jnp.ndarray
+    n_inbox_full: jnp.ndarray
+
+
+def d_min_of(p: SimParams) -> int:
+    """Network lookahead: minimum message latency (>= 1)."""
+    return max(int(np.min(p.delay_table())), 1)
+
+
+def inbox_cap(p: SimParams) -> int:
+    return max(16, 4 * p.n_nodes)
+
+
+def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
+               byz_silent=None) -> PSimState:
+    n = p.n_nodes
+    ic = inbox_cap(p)
+    F = payload_width(p)
+    seed = jnp.asarray(seed).astype(jnp.uint32)
+    delay_table = jnp.asarray(p.delay_table())
+    draws = jax.vmap(lambda c: H.rng_u32(seed, c.astype(jnp.uint32)))(jnp.arange(n))
+    startup = (delay_table[(draws >> (32 - TABLE_BITS)).astype(I32)] + 1).astype(I32)
+    if weights is None:
+        weights = jnp.ones((n,), I32)
+    if byz_equivocate is None:
+        byz_equivocate = jnp.zeros((n,), jnp.bool_)
+    if byz_silent is None:
+        byz_silent = jnp.zeros((n,), jnp.bool_)
+    return PSimState(
+        store=Store.initial(p, (n,)),
+        pm=Pacemaker.initial((n,)),
+        node=NodeExtra.initial((n,)),
+        ctx=Context.initial(p, (n,)),
+        in_valid=jnp.zeros((n, ic), jnp.bool_),
+        in_time=jnp.zeros((n, ic), I32),
+        in_kind=jnp.zeros((n, ic), I32),
+        in_stamp=jnp.zeros((n, ic), I32),
+        in_sender=jnp.zeros((n, ic), I32),
+        in_pay=jnp.zeros((n, ic, F), I32),
+        timer_time=startup,
+        startup=startup,
+        weights=jnp.asarray(weights, I32),
+        byz_equivocate=jnp.asarray(byz_equivocate, jnp.bool_),
+        byz_silent=jnp.asarray(byz_silent, jnp.bool_),
+        clock=_i32(0),
+        node_ctr=jnp.ones((n,), I32),
+        halted=jnp.bool_(False),
+        seed=seed,
+        n_events=_i32(0),
+        n_msgs_sent=_i32(0),
+        n_msgs_dropped=_i32(0),
+        n_inbox_full=_i32(0),
+    )
+
+
+def _node_earliest(p, st):
+    """Per node: earliest pending event by (time, kind desc, stamp).
+
+    Returns (time[N], kind[N], slot[N], is_timer[N]); slot = inbox slot
+    (or -1 for timer)."""
+    msg_time = jnp.where(st.in_valid, st.in_time, NEVER)
+    t_best = jnp.minimum(jnp.min(msg_time, axis=1), st.timer_time)  # [N]
+    m1 = msg_time == t_best[:, None]
+    k_msg = jnp.max(jnp.where(m1, st.in_kind, -1), axis=1)
+    timer_due = st.timer_time == t_best
+    k_best = jnp.maximum(k_msg, jnp.where(timer_due, KIND_TIMER, -1))
+    m2 = m1 & (st.in_kind == k_best[:, None])
+    s_best = jnp.min(jnp.where(m2, st.in_stamp, NEVER), axis=1)
+    # Timer wins at equal (time, kind=3): timers and messages never share a
+    # kind (messages are 0..2), so k_best==3 <=> timer.
+    is_timer = timer_due & (k_best == KIND_TIMER)
+    slot = jnp.argmax(m2 & (st.in_stamp == s_best[:, None]), axis=1).astype(I32)
+    slot = jnp.where(is_timer, -1, slot)
+    return t_best, k_best, slot, is_timer
+
+
+def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
+    """One window: every node whose earliest event falls in
+    [t_min, t_min + d_min) processes that event."""
+    n = p.n_nodes
+    ic = inbox_cap(p)
+    F = payload_width(p)
+
+    t_ev, k_ev, slot, is_timer = _node_earliest(p, st)
+    t_min = jnp.min(t_ev)
+    halt = st.halted | (t_min > p.max_clock)
+    live = ~halt
+    clock = jnp.maximum(st.clock, jnp.minimum(t_min, NEVER - 1))
+    active = live & (t_ev < jnp.minimum(t_min + d_min, NEVER))  # [N]
+    # Never process events beyond max_clock inside a window that started
+    # before it (they halt the next step).
+    active = active & (t_ev <= p.max_clock)
+
+    slot_c = jnp.maximum(slot, 0)
+    pay_rows = jnp.take_along_axis(st.in_pay, slot_c[:, None, None], axis=1)[:, 0]
+    sender = jnp.take_along_axis(st.in_sender, slot_c[:, None], axis=1)[:, 0]
+    # Consume selected inbox slots.
+    consume = active & ~is_timer
+    in_valid = st.in_valid.at[jnp.arange(n), slot_c].set(
+        jnp.where(consume, False, st.in_valid[jnp.arange(n), slot_c]))
+
+    is_notify = active & ~is_timer & (k_ev == KIND_NOTIFY)
+    is_request = active & ~is_timer & (k_ev == KIND_REQUEST)
+    is_response = active & ~is_timer & (k_ev == KIND_RESPONSE)
+    do_update = active & (is_timer | is_notify | is_response)
+    local_clock = t_ev - st.startup  # each node handles its own event time
+
+    def per_node(a, s_a, pm_a, nx_a, cx_a, pay_row, lclk):
+        pay_in = unpack_payload(p, pay_row)
+        s_n, should_sync = data_sync.handle_notification(p, s_a, st.weights, pay_in)
+        s_r, nx_r, cx_r = data_sync.handle_response(p, s_a, nx_a, cx_a,
+                                                    st.weights, pay_in)
+        s_in = store_ops._sel(is_notify[a], s_n,
+                              store_ops._sel(is_response[a], s_r, s_a))
+        nx_in = store_ops._sel(is_response[a], nx_r, nx_a)
+        cx_in = store_ops._sel(is_response[a], cx_r, cx_a)
+        s_u, pm_u, nx_u, cx_u, actions = node_ops.update_node(
+            p, s_in, pm_a, nx_in, cx_in, st.weights, a, lclk, dur_table)
+        s_f = store_ops._sel(do_update[a], s_u, s_in)
+        pm_f = store_ops._sel(do_update[a], pm_u, pm_a)
+        nx_f = store_ops._sel(do_update[a], nx_u, nx_in)
+        cx_f = store_ops._sel(do_update[a], cx_u, cx_in)
+        notif = data_sync.create_notification(p, s_f, a)
+        request = data_sync.create_request(p, s_f)
+        response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
+        notif_p = pack_payload(notif)
+        bank = jnp.stack([
+            notif_p,
+            pack_payload(_equivocate(p, notif)),
+            pack_payload(request),
+            pack_payload(response),
+        ])
+        return s_f, pm_f, nx_f, cx_f, actions, should_sync, bank
+
+    s_f, pm_f, nx_f, cx_f, actions, should_sync, banks = jax.vmap(per_node)(
+        jnp.arange(n), st.store, st.pm, st.node, st.ctx, pay_rows, local_clock)
+
+    # ---- Outgoing candidates: [N senders, 2n+1 candidates].
+    silent = st.byz_silent
+    want_sync_req = is_notify & should_sync & ~silent
+    want_response = is_request & ~silent
+    cand0_want = want_sync_req | want_response
+    cand0_kind = jnp.where(want_response, KIND_RESPONSE, KIND_REQUEST)
+    cand0_recv = jnp.clip(sender, 0, n - 1)
+    others = ~jnp.eye(n, dtype=bool)
+    send_mask = actions.send_mask & others & do_update[:, None] & ~silent[:, None]
+    query_mask = (actions.should_query_all & do_update & ~silent)[:, None] & others
+
+    nc = 2 * n + 1
+    want = jnp.concatenate([cand0_want[:, None], send_mask, query_mask], axis=1)
+    kinds = jnp.concatenate([
+        cand0_kind[:, None],
+        jnp.full((n, n), KIND_NOTIFY, I32),
+        jnp.full((n, n), KIND_REQUEST, I32),
+    ], axis=1)
+    recvs = jnp.concatenate([
+        cand0_recv[:, None],
+        jnp.broadcast_to(jnp.arange(n, dtype=I32), (n, n)),
+        jnp.broadcast_to(jnp.arange(n, dtype=I32), (n, n)),
+    ], axis=1)
+    upper = (jnp.arange(n) * 2 >= n)[None, :]
+    eq_sel = jnp.where(st.byz_equivocate[:, None] & upper, 1, 0)
+    pay_sel = jnp.concatenate([
+        jnp.where(want_response, 3, 2)[:, None],
+        eq_sel,
+        jnp.full((n, n), 2, I32),
+    ], axis=1)
+
+    # Per-sender stamps: node-local streams (ctr*N + node), disjoint across
+    # nodes so rng draws are deterministic however windows interleave.
+    pos = jnp.cumsum(want, axis=1) - 1
+    timer_gap = jnp.where(do_update, 1, 0)
+    local_idx = st.node_ctr[:, None] + pos + jnp.where(jnp.arange(nc)[None, :] > 0,
+                                                       timer_gap[:, None], 0)
+    stamps = local_idx * n + jnp.arange(n)[:, None]
+    consumed = jnp.sum(want, axis=1) + timer_gap
+    node_ctr = st.node_ctr + jnp.where(active, consumed, 0)
+
+    u_delay = H.rng_u32(st.seed, stamps.astype(jnp.uint32))
+    u_drop = H.mix32(u_delay, jnp.uint32(0x632BE59B))
+    delays = jnp.maximum(delay_table[(u_delay >> (32 - TABLE_BITS)).astype(I32)],
+                         d_min)
+    dropped = want & (u_drop < jnp.uint32(p.drop_u32))
+    arrive = t_ev[:, None] + delays  # sender's event time + latency
+    go = want & ~dropped
+
+    # ---- Route to receiver inboxes: flatten all M = N*(2n+1) candidates and
+    # scatter each into its receiver's free slots, ranked in (sender,
+    # candidate) order — deterministic regardless of window composition.
+    M = n * nc
+    flat_go = go.reshape(-1)
+    flat_recv = recvs.reshape(-1)
+    flat_kind = kinds.reshape(-1)
+    flat_stamp = stamps.reshape(-1)
+    flat_arrive = arrive.reshape(-1)
+    flat_sender = jnp.broadcast_to(jnp.arange(n, dtype=I32)[:, None],
+                                   (n, nc)).reshape(-1)
+    flat_paysel = pay_sel.reshape(-1)
+
+    recv_onehot = (flat_recv[None, :] == jnp.arange(n)[:, None]) & flat_go[None, :]
+    rank2d = jnp.cumsum(recv_onehot, axis=1) - 1         # [N, M]
+    rank_m = rank2d[flat_recv, jnp.arange(M)]            # [M] rank at receiver
+    free = ~in_valid                                     # [N, IC]
+    free_rank = jnp.cumsum(free, axis=1) - 1
+    n_free = jnp.sum(free, axis=1)                       # [N]
+    # slot_of_rank[r, k] = inbox slot holding receiver r's k-th free slot.
+    slot_of_rank = jnp.full((n, ic), ic, I32).at[
+        jnp.arange(n)[:, None], jnp.where(free, free_rank, ic)
+    ].set(jnp.broadcast_to(jnp.arange(ic, dtype=I32), (n, ic)), mode="drop")
+    overflow_m = flat_go & (rank_m >= jnp.minimum(n_free, ic)[flat_recv])
+    place_m = flat_go & ~overflow_m
+    slot_m = slot_of_rank[flat_recv, jnp.clip(rank_m, 0, ic - 1)]
+    # Global scatter target over the flattened [N*IC] inbox; N*IC == dropped.
+    g = jnp.where(place_m, flat_recv * ic + slot_m, n * ic)
+
+    flat_pay = banks[flat_sender, flat_paysel]           # [M, F]
+
+    in_valid2 = in_valid.reshape(-1).at[g].set(True, mode="drop").reshape(n, ic)
+    in_time2 = st.in_time.reshape(-1).at[g].set(flat_arrive, mode="drop").reshape(n, ic)
+    in_kind2 = st.in_kind.reshape(-1).at[g].set(flat_kind, mode="drop").reshape(n, ic)
+    in_stamp2 = st.in_stamp.reshape(-1).at[g].set(flat_stamp, mode="drop").reshape(n, ic)
+    in_sender2 = st.in_sender.reshape(-1).at[g].set(flat_sender, mode="drop").reshape(n, ic)
+    in_pay2 = st.in_pay.reshape(n * ic, F).at[g].set(flat_pay, mode="drop").reshape(n, ic, F)
+
+    # ---- Timer reschedule per active node.
+    next_g = jnp.where(
+        actions.next_sched >= NEVER, NEVER,
+        actions.next_sched + jnp.minimum(st.startup, NEVER - actions.next_sched))
+    timer_time = jnp.where(do_update, jnp.maximum(next_g, t_ev + 1), st.timer_time)
+
+    delivered = jnp.sum(place_m)
+
+    return st.replace(
+        store=s_f, pm=pm_f, node=nx_f, ctx=cx_f,
+        in_valid=in_valid2, in_time=in_time2, in_kind=in_kind2,
+        in_stamp=in_stamp2, in_sender=in_sender2, in_pay=in_pay2,
+        timer_time=timer_time,
+        clock=jnp.where(live, clock, st.clock),
+        node_ctr=node_ctr,
+        halted=halt,
+        n_events=st.n_events + jnp.where(live, jnp.sum(active), 0),
+        n_msgs_sent=st.n_msgs_sent + jnp.where(live, delivered, 0),
+        n_msgs_dropped=st.n_msgs_dropped + jnp.where(live, jnp.sum(dropped), 0),
+        n_inbox_full=st.n_inbox_full + jnp.where(live, jnp.sum(flat_go & overflow_m), 0),
+    )
+
+
+def _equivocate(p: SimParams, pay):
+    b = pay.prop_blk
+    tag = store_ops.block_tag(
+        pay.epoch, b.round, b.author, b.prev_round, b.prev_tag, b.time,
+        b.cmd_proposer, b.cmd_index + EQUIV_SALT)
+    return pay.replace(
+        prop_blk=b.replace(cmd_index=b.cmd_index + EQUIV_SALT, tag=tag),
+        vote=pay.vote.replace(valid=jnp.bool_(False)),
+    )
+
+
+def make_run_fn(p: SimParams, num_steps: int, batched: bool = True):
+    delay_table = jnp.asarray(p.delay_table())
+    dur_table = jnp.asarray(p.duration_table())
+    dmin = d_min_of(p)
+
+    def run(st):
+        def body(s, _):
+            return step(p, delay_table, dur_table, dmin, s), ()
+
+        st, _ = jax.lax.scan(body, st, None, length=num_steps)
+        return st
+
+    if batched:
+        run = jax.vmap(run)
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def init_batch(p: SimParams, seeds) -> PSimState:
+    seeds = jnp.asarray(seeds).astype(jnp.uint32)
+    return jax.vmap(lambda s: init_state(p, s))(seeds)
+
+
+def run_to_completion(p: SimParams, st: PSimState, chunk: int = 256,
+                      max_chunks: int = 400, batched: bool = False):
+    from .simulator import dedupe_buffers
+
+    run = make_run_fn(p, chunk, batched=batched)
+    st = dedupe_buffers(st)
+    for _ in range(max_chunks):
+        st = run(st)
+        if bool(np.all(jax.device_get(st.halted))):
+            break
+    return st

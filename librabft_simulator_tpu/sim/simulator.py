@@ -41,6 +41,8 @@ from ..core.types import (
     SimParams,
     SimState,
     Store,
+    pack_payload,
+    unpack_payload,
 )
 from ..utils import hashing as H
 from ..utils.quantile import TABLE_BITS
@@ -144,7 +146,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     a = jnp.where(is_timer, idx - cm, st.queue.receiver[midx]).astype(I32)
     a = jnp.clip(a, 0, n - 1)
     sender = st.queue.sender[midx]
-    pay_in = _node_slice(st.queue.payload, midx)
+    pay_in = unpack_payload(p, st.queue.payload[midx])
     # Consume the message slot.
     queue = st.queue.replace(valid=st.queue.valid.at[midx].set(
         jnp.where(live & ~is_timer, False, st.queue.valid[midx])))
@@ -181,10 +183,12 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     notif = data_sync.create_notification(p, s_f, a)
     notif_b = _equivocated_payload(p, s_f, a, notif)
     request = data_sync.create_request(p, s_f)
-    response = data_sync.handle_request(p, s_f, a, pay_in)
-    payload_bank = jax.tree.map(
-        lambda *xs: jnp.stack(xs), notif, notif_b, request, response
-    )
+    response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
+    # [4, F] packed bank: one row per candidate payload kind.
+    payload_bank = jnp.stack([
+        pack_payload(notif), pack_payload(notif_b),
+        pack_payload(request), pack_payload(response),
+    ])
 
     silent = st.byz_silent[a]
     others = jnp.arange(n) != a
@@ -242,7 +246,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     # (a -1 sentinel would WRAP to the last slot and corrupt the queue).
     tgt = jnp.where(go & ~overflow, slot_of_rank[jnp.clip(rank, 0, 2 * n)], _i32(cm))
 
-    out_pay = jax.tree.map(lambda bank: bank[pay_sel], payload_bank)
+    out_pay = payload_bank[pay_sel]  # [2n+1, F]
     queue = queue.replace(
         valid=queue.valid.at[tgt].set(True, mode="drop"),
         time=queue.time.at[tgt].set(arrive, mode="drop"),
@@ -250,9 +254,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         stamp=queue.stamp.at[tgt].set(stamps, mode="drop"),
         sender=queue.sender.at[tgt].set(a, mode="drop"),
         receiver=queue.receiver.at[tgt].set(recvs, mode="drop"),
-        payload=jax.tree.map(
-            lambda qf, of: qf.at[tgt].set(of, mode="drop"), queue.payload, out_pay
-        ),
+        payload=queue.payload.at[tgt].set(out_pay, mode="drop"),
     )
 
     # ---- Timer reschedule (process_node_actions, simulator.rs:310-324).
