@@ -1,7 +1,7 @@
 """Round-contract benchmark: aggregate consensus-round throughput.
 
 Runs a fleet of independent LibraBFTv2 instances (BASELINE config #2 shape:
-4 nodes per instance) as one jitted, vmapped step function and reports
+4 nodes per instance) and reports
 
     {"metric": "rounds_per_sec", "value": ..., "unit": "rounds/sec",
      "vs_baseline": value / 1e6, ...}
@@ -9,47 +9,110 @@ Runs a fleet of independent LibraBFTv2 instances (BASELINE config #2 shape:
 on a single line of stdout.  ``vs_baseline`` is against the reference north
 star of >=1M consensus rounds/sec aggregate (BASELINE.json).
 
-Environment knobs: BENCH_B (instances), BENCH_STEPS (timed events/instance),
-BENCH_NODES, BENCH_SWEEP=1 to also print per-config lines for BASELINE
-configs 1-5 (stderr, not the contract line).
+Platform handling (the part that decides whether this file produces a number
+at all): the environment's TPU plugin can HANG backend init indefinitely when
+the TPU tunnel is down and it ignores ``JAX_PLATFORMS``.  So before touching
+any backend in-process we probe the default backend in a *subprocess with a
+timeout*; on failure/timeout we force the CPU backend via
+``jax.config.update("jax_platforms", "cpu")`` (which the plugin does honor)
+and still print the contract line with a truthful ``platform`` field.  Any
+in-run failure re-execs once with ``BENCH_PLATFORM=cpu``; the last-resort
+path prints a contract line with ``value: 0`` and an ``error`` field.
+
+Environment knobs: BENCH_PLATFORM (cpu|default: skip the probe),
+BENCH_PROBE_TIMEOUT, BENCH_B (instances), BENCH_STEPS (events or windows per
+rep), BENCH_REPS, BENCH_NODES, BENCH_ENGINE (parallel|serial|both).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
 
-import jax
+def _decide_platform() -> str:
+    forced = os.environ.get("BENCH_PLATFORM")
+    if forced:
+        return forced
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout)
+        for line in (r.stdout or "").splitlines():
+            if line.startswith("PLATFORM="):
+                return line[len("PLATFORM="):].strip() or "cpu"
+    except Exception:
+        pass
+    return "cpu"
+
+
+_PLATFORM = _decide_platform()
+
+import jax  # noqa: E402
+
+if _PLATFORM == "cpu":
+    # Must land before any backend init; the config flag beats plugins that
+    # ignore the JAX_PLATFORMS env var.
+    jax.config.update("jax_platforms", "cpu")
 
 os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-import jax.numpy as jnp
-
-from librabft_simulator_tpu.core.types import SimParams
-from librabft_simulator_tpu.sim import simulator as S
+import numpy as np  # noqa: E402
 
 
-def fleet_rounds(st) -> int:
+def _fleet_rounds(current_round) -> int:
     """Rounds completed per instance = max round any of its nodes reached
     (current_round starts at 1); summed over the fleet."""
-    cur = jax.device_get(st.store.current_round)  # [B, N]
+    cur = jax.device_get(current_round)  # [B, N]
     return int(np.sum(np.max(cur, axis=-1) - 1))
 
 
-def fleet_commits(st) -> int:
-    return int(np.sum(jax.device_get(st.ctx.commit_count)))
+def _time_engine(engine, p, batch, chunk, reps):
+    """1 warmup call of one compiled chunk-scan + ``reps`` timed calls."""
+    seeds = np.arange(batch, dtype=np.uint32)
+    st = engine.init_batch(p, seeds)
+    from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+
+    st = dedupe_buffers(st)
+    run = engine.make_run_fn(p, chunk)
+    t_c = time.perf_counter()
+    st = run(st)  # compile + reach steady state
+    jax.block_until_ready(st)
+    compile_s = time.perf_counter() - t_c
+    r0 = _fleet_rounds(st.store.current_round)
+    c0 = int(np.sum(jax.device_get(st.ctx.commit_count)))
+    e0 = int(np.sum(jax.device_get(st.n_events)))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st = run(st)
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+    r1 = _fleet_rounds(st.store.current_round)
+    c1 = int(np.sum(jax.device_get(st.ctx.commit_count)))
+    e1 = int(np.sum(jax.device_get(st.n_events)))
+    return {
+        "rounds_per_sec": (r1 - r0) / dt,
+        "commits_per_sec": (c1 - c0) / dt,
+        "events_per_sec": (e1 - e0) / dt,
+        "elapsed_s": dt,
+        "compile_s": compile_s,
+    }
 
 
-def run_bench(n_nodes: int, batch: int, chunk: int = 128, reps: int = 4,
-              delay_kind: str = "uniform", drop: float = 0.0):
-    """One compiled ``chunk``-step scan, reused: 1 warmup call + ``reps``
-    timed calls (a single XLA program keeps compile time bounded)."""
+def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
+              engine_name: str, delay_kind: str = "uniform",
+              drop: float = 0.0) -> dict:
+    from librabft_simulator_tpu.core.types import SimParams
+    from librabft_simulator_tpu.sim import parallel_sim, simulator
+
+    engine = parallel_sim if engine_name == "parallel" else simulator
     p = SimParams(
         n_nodes=n_nodes,
         delay_kind=delay_kind,
@@ -57,49 +120,62 @@ def run_bench(n_nodes: int, batch: int, chunk: int = 128, reps: int = 4,
         max_clock=2**30,  # never halt inside the timed window
         queue_cap=max(32, 4 * n_nodes),
     )
-    seeds = np.arange(batch, dtype=np.uint32)
-    st = S.init_batch(p, seeds)
-    st = S.dedupe_buffers(st)
-    run = S.make_run_fn(p, chunk)
-    st = run(st)  # compile + reach steady state
-    jax.block_until_ready(st)
-    r0, c0 = fleet_rounds(st), fleet_commits(st)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        st = run(st)
-    jax.block_until_ready(st)
-    dt = time.perf_counter() - t0
-    r1, c1 = fleet_rounds(st), fleet_commits(st)
-    return {
-        "rounds_per_sec": (r1 - r0) / dt,
-        "commits_per_sec": (c1 - c0) / dt,
-        "events_per_sec": batch * chunk * reps / dt,
-        "elapsed_s": dt,
-        "instances": batch,
-        "n_nodes": n_nodes,
-        "steps": chunk * reps,
+    res = _time_engine(engine, p, batch, chunk, reps)
+    res.update(instances=batch, n_nodes=n_nodes, steps=chunk * reps,
+               engine=engine_name)
+    return res
+
+
+def run_all() -> dict:
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    batch = int(os.environ.get("BENCH_B", 32768 if on_tpu else 2048))
+    chunk = int(os.environ.get("BENCH_STEPS", 128 if on_tpu else 32))
+    reps = int(os.environ.get("BENCH_REPS", 4 if on_tpu else 2))
+    n_nodes = int(os.environ.get("BENCH_NODES", 4))
+    mode = os.environ.get("BENCH_ENGINE", "both")
+
+    results = {}
+    if mode in ("parallel", "both"):
+        results["parallel"] = run_bench(n_nodes, batch, chunk, reps, "parallel")
+    if mode in ("serial", "both"):
+        results["serial"] = run_bench(
+            n_nodes, batch, chunk, reps, "serial")
+    head = results.get("parallel") or results["serial"]
+    out = {
+        "metric": "rounds_per_sec",
+        "value": round(head["rounds_per_sec"], 1),
+        "unit": "rounds/sec",
+        "vs_baseline": round(head["rounds_per_sec"] / 1e6, 4),
+        "engine": head["engine"],
+        "commits_per_sec": round(head["commits_per_sec"], 1),
+        "events_per_sec": round(head["events_per_sec"], 1),
+        "compile_s": round(head["compile_s"], 1),
+        "instances": head["instances"],
+        "n_nodes": head["n_nodes"],
+        "platform": platform,
     }
+    if "serial" in results and "parallel" in results:
+        out["serial_rounds_per_sec"] = round(
+            results["serial"]["rounds_per_sec"], 1)
+    return out
 
 
 def main():
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
-    batch = int(os.environ.get("BENCH_B", 32768 if on_tpu else 2048))
-    chunk = int(os.environ.get("BENCH_STEPS", 128 if on_tpu else 64))
-    reps = int(os.environ.get("BENCH_REPS", 4 if on_tpu else 2))
-    n_nodes = int(os.environ.get("BENCH_NODES", 4))
-    res = run_bench(n_nodes, batch, chunk, reps)
-    out = {
-        "metric": "rounds_per_sec",
-        "value": round(res["rounds_per_sec"], 1),
-        "unit": "rounds/sec",
-        "vs_baseline": round(res["rounds_per_sec"] / 1e6, 4),
-        "commits_per_sec": round(res["commits_per_sec"], 1),
-        "events_per_sec": round(res["events_per_sec"], 1),
-        "instances": res["instances"],
-        "n_nodes": n_nodes,
-        "platform": platform,
-    }
+    try:
+        out = run_all()
+    except Exception as e:  # noqa: BLE001 - contract line must still print
+        if _PLATFORM != "cpu":
+            # Retry once on the always-available backend.
+            env = dict(os.environ, BENCH_PLATFORM="cpu")
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env)
+            sys.exit(r.returncode)
+        out = {
+            "metric": "rounds_per_sec", "value": 0.0, "unit": "rounds/sec",
+            "vs_baseline": 0.0, "platform": "none",
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }
     print(json.dumps(out))
 
 
