@@ -17,7 +17,7 @@ from flax import struct
 
 from . import pacemaker as pm_ops
 from . import store as store_ops
-from .types import NEVER, Context, NodeExtra, Pacemaker, SimParams, Store
+from .types import NEVER, Context, NodeExtra, Pacemaker, SimParams, Store, sat_add
 
 I32 = jnp.int32
 
@@ -202,11 +202,11 @@ def update_tracker(p: SimParams, nx: NodeExtra, s: Store, clock):
         tracker_commit_time=jnp.where(bump, _i32(clock), nx.tracker_commit_time),
     )
     base = jnp.maximum(nx.tracker_commit_time, nx.latest_query_all)
-    # Saturating add (see pacemaker.update_pacemaker): base can approach NEVER.
-    deadline = base + jnp.minimum(_i32(p.target_commit_interval), _i32(NEVER) - base)
+    # Saturating add (types.sat_add): base can approach NEVER or be a
+    # negative pre-startup local time.
+    deadline = sat_add(base, _i32(p.target_commit_interval))
     should_query_all = clock >= deadline
     deadline = jnp.where(
-        should_query_all,
-        clock + jnp.minimum(_i32(p.target_commit_interval), _i32(NEVER) - clock),
+        should_query_all, sat_add(clock, _i32(p.target_commit_interval)),
         deadline)
     return nx, should_query_all, deadline

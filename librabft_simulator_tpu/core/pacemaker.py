@@ -14,7 +14,7 @@ from flax import struct
 
 from . import config
 from . import store as store_ops
-from .types import NEVER, Pacemaker, SimParams, Store
+from .types import NEVER, Pacemaker, SimParams, Store, sat_add
 
 I32 = jnp.int32
 
@@ -84,12 +84,10 @@ def update_pacemaker(
     next_sched = jnp.where(should_propose, _i32(clock), next_sched)
 
     has_to = store_ops.has_timeout(s, author, pm2.active_round)
-    # a + min(b, NEVER - a) == min(a + b, NEVER) without int32 wraparound —
-    # round durations reach ~2^30 (delta * n^gamma, table-capped at NEVER//2),
-    # so plain adds overflow once a node stalls long enough.  The oracle and
-    # the C++ engine compute the same saturating sums in wide integers.
-    timeout_deadline = pm2.round_start + jnp.minimum(
-        pm2.round_duration, _i32(NEVER) - pm2.round_start)
+    # Saturating NodeTime sums (sat_add == the oracle's wide-int min(a+b,
+    # NEVER)): round durations reach ~2^30 so plain adds overflow, and bases
+    # (round_start / clock / latest_query_all) can be negative local times.
+    timeout_deadline = sat_add(pm2.round_start, pm2.round_duration)
     past_deadline = clock >= timeout_deadline
     should_create_timeout = ~has_to & past_deadline
     should_broadcast = should_broadcast | should_create_timeout
@@ -103,10 +101,9 @@ def update_pacemaker(
     # Low-part product can reach 2^32 (lam == 1): keep it in uint32.
     lo_term = ((d_lo.astype(jnp.uint32) * jnp.uint32(p.lam_fp)) >> 16).astype(I32)
     period = d_hi * _i32(p.lam_fp) + lo_term
-    qad = latest_query_all + jnp.minimum(period, _i32(NEVER) - latest_query_all)
+    qad = sat_add(latest_query_all, period)
     should_query_all = has_to & (clock >= qad)
-    qad = jnp.where(should_query_all,
-                    clock + jnp.minimum(period, _i32(NEVER) - clock), qad)
+    qad = jnp.where(should_query_all, sat_add(clock, period), qad)
     next_sched = jnp.where(has_to, jnp.minimum(next_sched, qad), next_sched)
 
     actions = PacemakerActions(

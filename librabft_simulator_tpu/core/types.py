@@ -36,6 +36,20 @@ Array = Any
 
 NEVER = np.int32(2**31 - 1)  # NodeTime::never() (/root/reference/bft-lib/src/base_types.rs:57)
 
+
+def sat_add(a, b):
+    """min(a + b, NEVER) without int32 wraparound, for b in [0, NEVER].
+
+    NodeTime arithmetic must saturate at NEVER (the oracle uses unbounded
+    Python ints, the C++ engine wide i64); deadlines reach NEVER (durations
+    are table-capped at NEVER//2 but bases approach NEVER) and bases can be
+    NEGATIVE — a node handling a message delivered before its startup time
+    runs at a negative local clock (simulator.rs:120-121).  The classic
+    ``a + min(b, NEVER - a)`` guard breaks for a < 0 (``NEVER - a`` wraps);
+    clamping the subtrahend to ``max(a, 0)`` covers both signs exactly."""
+    a = jnp.asarray(a, jnp.int32)
+    return a + jnp.minimum(jnp.asarray(b, jnp.int32), NEVER - jnp.maximum(a, 0))
+
 # Event kinds; priority at equal time is DESCENDING kind
 # (/root/reference/bft-lib/src/simulator.rs:149-161).
 KIND_NOTIFY = 0

@@ -48,6 +48,7 @@ from ..core.types import (
     Store,
     pack_payload,
     payload_width,
+    sat_add,
     unpack_payload,
 )
 from ..utils import hashing as H
@@ -311,10 +312,8 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     in_sender2 = st.in_sender.reshape(-1).at[g].set(flat_sender, mode="drop").reshape(n, ic)
     in_pay2 = st.in_pay.reshape(n * ic, F).at[g].set(flat_pay, mode="drop").reshape(n, ic, F)
 
-    # ---- Timer reschedule per active node.
-    next_g = jnp.where(
-        actions.next_sched >= NEVER, NEVER,
-        actions.next_sched + jnp.minimum(st.startup, NEVER - actions.next_sched))
+    # ---- Timer reschedule per active node (sat_add: see types.sat_add).
+    next_g = sat_add(actions.next_sched, st.startup)
     timer_time = jnp.where(do_update, jnp.maximum(next_g, t_ev + 1), st.timer_time)
 
     delivered = jnp.sum(place_m)

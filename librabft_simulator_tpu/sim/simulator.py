@@ -42,6 +42,7 @@ from ..core.types import (
     SimState,
     Store,
     pack_payload,
+    sat_add,
     unpack_payload,
 )
 from ..utils import hashing as H
@@ -258,12 +259,10 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     )
 
     # ---- Timer reschedule (process_node_actions, simulator.rs:310-324).
-    # Saturating add: next_sched + startup without int32 wrap (== the wide-int
-    # min(next + startup, NEVER) of the oracle and C++ engine).
-    next_g = jnp.where(
-        actions.next_sched >= NEVER, NEVER,
-        actions.next_sched + jnp.minimum(st.startup[a], NEVER - actions.next_sched),
-    )
+    # sat_add: next_sched + startup without int32 wrap (== the wide-int
+    # min(next + startup, NEVER) of the oracle and C++ engine), valid for
+    # negative next_sched (pre-startup local times).
+    next_g = sat_add(actions.next_sched, st.startup[a])
     new_timer = jnp.maximum(next_g, clock + 1)
     timer_time = jnp.where(do_update, st.timer_time.at[a].set(new_timer), st.timer_time)
     timer_stamp = jnp.where(

@@ -68,11 +68,28 @@ def test_end_to_end_hotstuff_16_nodes():
         assert_safety(jax.tree.map(lambda x: x[b], st), 16)
 
 
-def test_two_chain_commits_faster_end_to_end():
-    p2 = SimParams(n_nodes=3, max_clock=800, commit_chain=2)
-    p3 = SimParams(n_nodes=3, max_clock=800, commit_chain=3)
-    st2 = S.run_to_completion(p2, S.init_state(p2, 21))
-    st3 = S.run_to_completion(p3, S.init_state(p3, 21))
-    # Same trajectory of rounds; the 2-chain rule can only commit earlier.
-    assert int(np.asarray(st2.ctx.commit_count).min()) >= \
-        int(np.asarray(st3.ctx.commit_count).min())
+def test_two_chain_commit_latency_on_fixed_chain():
+    # The sound comparison runs both rules over the SAME contiguous QC chain
+    # (commit timing feeds back into round durations in a full simulation, so
+    # "2-chain commits more per wall-clock" is not a theorem seed-by-seed).
+    # On a chain of contiguous QCs at rounds 1..K, the C-chain rule commits
+    # round r once QCs r..r+C-1 exist: hcr = max(0, K - C + 1).
+    w = jnp.ones((3,), jnp.int32)
+    p2 = SimParams(n_nodes=3, commit_chain=2)
+    p3 = SimParams(n_nodes=3, commit_chain=3)
+    s2, s3 = Store.initial(p2), Store.initial(p3)
+    for k in range(1, 7):
+        s2 = make_round(p2, s2, w, 10 * k)
+        s3 = make_round(p3, s3, w, 10 * k)
+        assert int(s2.hcr) == max(0, k - 1)
+        assert int(s3.hcr) == max(0, k - 2)
+        assert int(s2.hcr) >= int(s3.hcr)  # 2-chain is never later
+
+
+def test_two_chain_end_to_end_live_and_safe():
+    # Both rules stay live and safe on the same seed in full simulation.
+    for chain in (2, 3):
+        p = SimParams(n_nodes=3, max_clock=800, commit_chain=chain)
+        st = S.run_to_completion(p, S.init_state(p, 21))
+        assert int(np.asarray(st.ctx.commit_count).min()) >= 3
+        assert_safety(st, 3)

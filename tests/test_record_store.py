@@ -186,14 +186,17 @@ def test_vote_committed_state_matches_commit_rule():
     leader = st.leader()
     assert st.propose(leader, 30)
     var = int(st.s.proposed_var)
-    ok, d, t = store_ops.vote_committed_state(st.p, st.s, st.s.current_round, var)
+    ok, d, t, undet = store_ops.vote_committed_state(
+        st.p, st.s, st.s.current_round, var)
     assert bool(ok) and int(d) == 1
+    assert not bool(undet)  # no state-sync anchor in this store
     # After a TC gap, the chain is non-contiguous -> no commit.
     st.make_tc()
     leader = st.leader()
     assert st.propose(leader, 40)
     var = int(st.s.proposed_var)
-    ok, _, _ = store_ops.vote_committed_state(st.p, st.s, st.s.current_round, var)
+    ok, _, _, _ = store_ops.vote_committed_state(
+        st.p, st.s, st.s.current_round, var)
     assert not bool(ok)
 
 
