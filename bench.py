@@ -20,8 +20,9 @@ in-run failure re-execs once with ``BENCH_PLATFORM=cpu``; the last-resort
 path prints a contract line with ``value: 0`` and an ``error`` field.
 
 Environment knobs: BENCH_PLATFORM (cpu|default: skip the probe),
-BENCH_PROBE_TIMEOUT, BENCH_B (instances), BENCH_STEPS (events or windows per
-rep), BENCH_REPS, BENCH_NODES, BENCH_ENGINE (parallel|serial|both).
+BENCH_PROBE_TIMEOUT (s per attempt, default 180), BENCH_PROBE_RETRIES
+(default 3), BENCH_B (instances), BENCH_STEPS (events or windows per rep),
+BENCH_REPS, BENCH_NODES, BENCH_ENGINE (parallel|serial|both).
 """
 
 from __future__ import annotations
@@ -33,25 +34,45 @@ import sys
 import time
 
 
-def _decide_platform() -> str:
+def _decide_platform() -> tuple[str, dict]:
+    """Probe the default backend in a subprocess (the TPU plugin can hang
+    in-process init indefinitely when its tunnel is down).  The probe is
+    retried: a single-chip tunnel refuses a second holder, so a transient
+    failure (another process releasing the chip) must not demote a whole
+    graded run to CPU.  Returns (platform, probe_diagnostics)."""
+    diag = {"attempts": [], "forced": None}
     forced = os.environ.get("BENCH_PLATFORM")
     if forced:
-        return forced
-    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout)
-        for line in (r.stdout or "").splitlines():
-            if line.startswith("PLATFORM="):
-                return line[len("PLATFORM="):].strip() or "cpu"
-    except Exception:
-        pass
-    return "cpu"
+        diag["forced"] = forced
+        return forced, diag
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+    for attempt in range(retries):
+        t0 = time.perf_counter()
+        rec = {"seconds": None, "rc": None, "error": None, "platform": None}
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=timeout)
+            rec["rc"] = r.returncode
+            for line in (r.stdout or "").splitlines():
+                if line.startswith("PLATFORM="):
+                    rec["platform"] = line[len("PLATFORM="):].strip() or "cpu"
+            if rec["platform"] is None:
+                rec["error"] = (r.stderr or "")[-300:]
+        except Exception as e:  # noqa: BLE001 - timeout or spawn failure
+            rec["error"] = f"{type(e).__name__}: {e}"[:300]
+        rec["seconds"] = round(time.perf_counter() - t0, 1)
+        diag["attempts"].append(rec)
+        if rec["platform"] is not None:
+            return rec["platform"], diag
+        if attempt < retries - 1:
+            time.sleep(min(10.0 * (attempt + 1), 30.0))
+    return "cpu", diag
 
 
-_PLATFORM = _decide_platform()
+_PLATFORM, _PROBE_DIAG = _decide_platform()
 
 import jax  # noqa: E402
 
@@ -74,12 +95,17 @@ def _fleet_rounds(current_round) -> int:
     return int(np.sum(np.max(cur, axis=-1) - 1))
 
 
-def _time_engine(engine, p, batch, chunk, reps):
+def _time_engine(engine, p, batch, chunk, reps, init_kw=None):
     """1 warmup call of one compiled chunk-scan + ``reps`` timed calls."""
-    seeds = np.arange(batch, dtype=np.uint32)
-    st = engine.init_batch(p, seeds)
+    import jax.numpy as jnp
     from librabft_simulator_tpu.sim.simulator import dedupe_buffers
 
+    seeds = np.arange(batch, dtype=np.uint32)
+    if init_kw:
+        st = jax.vmap(lambda s: engine.init_state(p, s, **init_kw))(
+            jnp.asarray(seeds))
+    else:
+        st = engine.init_batch(p, seeds)
     st = dedupe_buffers(st)
     run = engine.make_run_fn(p, chunk)
     t_c = time.perf_counter()
@@ -97,30 +123,37 @@ def _time_engine(engine, p, batch, chunk, reps):
     r1 = _fleet_rounds(st.store.current_round)
     c1 = int(np.sum(jax.device_get(st.ctx.commit_count)))
     e1 = int(np.sum(jax.device_get(st.n_events)))
+    # Fidelity: fraction of sends lost to queue/inbox overflow (0 = faithful).
+    lost_field = st.n_queue_full if hasattr(st, "n_queue_full") else st.n_inbox_full
+    lost = int(np.sum(jax.device_get(lost_field)))
+    sent = int(np.sum(jax.device_get(st.n_msgs_sent)))
     return {
         "rounds_per_sec": (r1 - r0) / dt,
         "commits_per_sec": (c1 - c0) / dt,
         "events_per_sec": (e1 - e0) / dt,
         "elapsed_s": dt,
         "compile_s": compile_s,
+        "overflow_frac": round(lost / max(sent + lost, 1), 4),
     }
 
 
 def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
               engine_name: str, delay_kind: str = "uniform",
-              drop: float = 0.0) -> dict:
+              drop: float = 0.0, **params_kw) -> dict:
     from librabft_simulator_tpu.core.types import SimParams
     from librabft_simulator_tpu.sim import parallel_sim, simulator
 
     engine = parallel_sim if engine_name == "parallel" else simulator
+    init_kw = params_kw.pop("init_kw", None)
+    params_kw.setdefault("queue_cap", max(32, 4 * n_nodes))
     p = SimParams(
         n_nodes=n_nodes,
         delay_kind=delay_kind,
         drop_prob=drop,
         max_clock=2**30,  # never halt inside the timed window
-        queue_cap=max(32, 4 * n_nodes),
+        **params_kw,
     )
-    res = _time_engine(engine, p, batch, chunk, reps)
+    res = _time_engine(engine, p, batch, chunk, reps, init_kw=init_kw)
     res.update(instances=batch, n_nodes=n_nodes, steps=chunk * reps,
                engine=engine_name)
     return res
@@ -141,7 +174,9 @@ def run_all() -> dict:
     if mode in ("serial", "both"):
         results["serial"] = run_bench(
             n_nodes, batch, chunk, reps, "serial")
-    head = results.get("parallel") or results["serial"]
+    # Headline = the fastest engine at this config (both are zero-loss at the
+    # 4-node shape; overflow_frac records fidelity either way).
+    head = max(results.values(), key=lambda r: r["rounds_per_sec"])
     out = {
         "metric": "rounds_per_sec",
         "value": round(head["rounds_per_sec"], 1),
@@ -151,22 +186,85 @@ def run_all() -> dict:
         "commits_per_sec": round(head["commits_per_sec"], 1),
         "events_per_sec": round(head["events_per_sec"], 1),
         "compile_s": round(head["compile_s"], 1),
+        "overflow_frac": head["overflow_frac"],
         "instances": head["instances"],
         "n_nodes": head["n_nodes"],
         "platform": platform,
+        "probe": _PROBE_DIAG,
     }
-    if "serial" in results and "parallel" in results:
-        out["serial_rounds_per_sec"] = round(
-            results["serial"]["rounds_per_sec"], 1)
+    for name, r in results.items():
+        if r is not head:
+            out[f"{name}_rounds_per_sec"] = round(r["rounds_per_sec"], 1)
     return out
 
 
+# BASELINE.json's five configs: (name, kwargs for run_bench).  Engine choice
+# per shape: serial (one event per instance-step, shared queue) wins at small
+# n; the parallel windowed engine is the only *faithful* option at n >= 16,
+# where the serial queue needs O(n^2) capacity to stop overflowing
+# (overflow_frac in the output records this).
+def sweep_configs(scale: float = 1.0):
+    from librabft_simulator_tpu.sim.byzantine import byz_masks
+    from librabft_simulator_tpu.core.types import SimParams
+
+    b = lambda x: max(int(x * scale), 1)  # noqa: E731
+    eq4, _, _ = byz_masks(SimParams(n_nodes=4), 1, "equivocate")
+    return [
+        ("1_3node_single", dict(n_nodes=3, batch=1, engine_name="serial",
+                                delay_kind="lognormal")),
+        ("2_4node_10k_uniform", dict(n_nodes=4, batch=b(10000),
+                                     engine_name="serial",
+                                     delay_kind="uniform")),
+        ("3_64node_1k_pareto_drop", dict(n_nodes=64, batch=b(1000),
+                                         engine_name="parallel",
+                                         delay_kind="pareto", drop=0.05,
+                                         inbox_cap=48)),
+        ("4_byz_f1_10k", dict(n_nodes=4, batch=b(10000),
+                              engine_name="serial", delay_kind="uniform",
+                              init_kw=dict(byz_equivocate=eq4))),
+        ("5_2chain_16node_10k", dict(n_nodes=16, batch=b(10000),
+                                     engine_name="parallel",
+                                     delay_kind="uniform", commit_chain=2)),
+    ]
+
+
+def run_sweep(out_path: str) -> None:
+    """Benchmark all five BASELINE configs; write one JSON object per config
+    to ``out_path`` (stdout keeps the single-line contract)."""
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    scale = float(os.environ.get("BENCH_SWEEP_SCALE", 1.0 if on_tpu else 0.1))
+    chunk = int(os.environ.get("BENCH_STEPS", 64 if on_tpu else 16))
+    reps = int(os.environ.get("BENCH_REPS", 2))
+    rows = []
+    for name, kw in sweep_configs(scale):
+        try:
+            r = run_bench(chunk=chunk, reps=reps, **kw)
+            r["config"] = name
+        except Exception as e:  # noqa: BLE001 - record and continue
+            r = {"config": name, "error": f"{type(e).__name__}: {e}"[:300]}
+        r["platform"] = platform
+        rows.append(r)
+        print(json.dumps(r), file=sys.stderr, flush=True)
+    with open(out_path, "w") as f:
+        json.dump({"platform": platform, "scale": scale, "configs": rows}, f,
+                  indent=1)
+
+
 def main():
+    if os.environ.get("BENCH_SWEEP"):
+        run_sweep(os.environ.get("BENCH_SWEEP_OUT", "BENCH_SWEEP.json"))
+        return
     try:
         out = run_all()
     except Exception as e:  # noqa: BLE001 - contract line must still print
+        import traceback
+
+        traceback.print_exc()
         if _PLATFORM != "cpu":
             # Retry once on the always-available backend.
+            print(f"bench: {_PLATFORM} run failed ({type(e).__name__}); "
+                  "re-running on cpu", file=sys.stderr)
             env = dict(os.environ, BENCH_PLATFORM="cpu")
             r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                env=env)
@@ -175,6 +273,7 @@ def main():
             "metric": "rounds_per_sec", "value": 0.0, "unit": "rounds/sec",
             "vs_baseline": 0.0, "platform": "none",
             "error": f"{type(e).__name__}: {e}"[:300],
+            "probe": _PROBE_DIAG,
         }
     print(json.dumps(out))
 

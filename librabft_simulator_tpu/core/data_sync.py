@@ -54,6 +54,8 @@ def qc_msg_at(p: SimParams, s: Store, r, var, valid):
         commit_valid=s.qc_commit_valid[sl, var],
         commit_depth=s.qc_commit_depth[sl, var],
         commit_tag=s.qc_commit_tag[sl, var],
+        votes_lo=s.qc_votes_lo[sl, var],
+        votes_hi=s.qc_votes_hi[sl, var],
         author=s.qc_author[sl, var],
         tag=s.qc_tag[sl, var],
     )
@@ -214,6 +216,9 @@ def handle_response(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         last_depth=jnp.where(adopt, pay.hcc.commit_depth, ctx.last_depth),
         last_tag=jnp.where(adopt, pay.hcc.commit_tag, ctx.last_tag),
         sync_jumps=ctx.sync_jumps + jnp.where(do_jump, 1, 0),
+        # Adopted depths (last_depth+1 .. commit_depth) never reach the log.
+        skipped_commits=ctx.skipped_commits + jnp.where(
+            adopt, pay.hcc.commit_depth - ctx.last_depth, 0),
     )
     # Replay the chain tail in ascending order: block then QC.  lax.scan keeps
     # the insert machinery traced once instead of K times (it is the single

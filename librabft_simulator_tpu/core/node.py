@@ -132,7 +132,7 @@ def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
     H_ = p.commit_log
 
     def deliver(carry, x):
-        (cc, lc_d, lc_t, lr, ld, lt, stopped, sw, sw_e, sw_d, sw_t) = carry
+        (cc, lc_d, lc_t, sk, lr, ld, lt, stopped, sw, sw_e, sw_d, sw_t) = carry
         valid, r, d, t = x
         do = valid & ~stopped & (d > lc_d)
         # StateFinalizer::commit (simulated_context.rs:161-185): ring append.
@@ -141,6 +141,9 @@ def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         ld = jnp.where(do, ld.at[pos].set(d), ld)
         lt = jnp.where(do, lt.at[pos].set(t), lt)
         cc = cc + jnp.where(do, 1, 0)
+        # Depths between the last delivery and this one were bypassed (the
+        # K-tail response didn't carry their records): account them.
+        sk = sk + jnp.where(do, d - lc_d - 1, 0)
         lc_d = jnp.where(do, d, lc_d)
         lc_t = jnp.where(do, t, lc_t)
         # EpochReader::read_epoch_id = depth // commands_per_epoch
@@ -152,18 +155,18 @@ def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         sw_d = jnp.where(switch, d, sw_d)
         sw_t = jnp.where(switch, t, sw_t)
         stopped = stopped | switch
-        return (cc, lc_d, lc_t, lr, ld, lt, stopped, sw, sw_e, sw_d, sw_t), None
+        return (cc, lc_d, lc_t, sk, lr, ld, lt, stopped, sw, sw_e, sw_d, sw_t), None
 
     init = (
-        ctx.commit_count, ctx.last_depth, ctx.last_tag,
+        ctx.commit_count, ctx.last_depth, ctx.last_tag, ctx.skipped_commits,
         ctx.log_round, ctx.log_depth, ctx.log_tag,
         jnp.bool_(False), jnp.bool_(False), _i32(0), _i32(0), jnp.zeros((), jnp.uint32),
     )
-    (cc, lc_d, lc_t, lr, ld, lt, _, sw, sw_e, sw_d, sw_t), _ = jax.lax.scan(
+    (cc, lc_d, lc_t, sk, lr, ld, lt, _, sw, sw_e, sw_d, sw_t), _ = jax.lax.scan(
         deliver, init, (keep, rounds, depths, tags)
     )
     ctx = ctx.replace(
-        commit_count=cc, last_depth=lc_d, last_tag=lc_t,
+        commit_count=cc, last_depth=lc_d, last_tag=lc_t, skipped_commits=sk,
         log_round=lr, log_depth=ld, log_tag=lt,
     )
     # Epoch switch (node.rs:330-348): fresh record store anchored at the

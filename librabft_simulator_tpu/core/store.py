@@ -274,6 +274,24 @@ def author_mask_words(mask):
     return lo, hi
 
 
+def mask_weight(p: SimParams, weights, lo, hi):
+    """Total voting weight of the authors set in the (lo, hi) bit mask, plus
+    a validity flag rejecting bits outside 0..n-1 (an 'unknown author' in a
+    QC vote list, record_store.rs:371-379)."""
+    n = p.n_nodes
+    idx = jnp.arange(n)
+    word = jnp.where(idx < 32, lo, hi)
+    bit = (word >> _u(jnp.where(idx < 32, idx, idx - 32))) & U32(1)
+    w = jnp.sum(jnp.where(bit == 1, weights, 0))
+    if n >= 64:
+        known = jnp.bool_(True)
+    elif n >= 32:
+        known = (hi >> _u(n - 32)) == 0
+    else:
+        known = ((lo >> _u(n)) == 0) & (hi == U32(0))
+    return w, known
+
+
 # ---------------------------------------------------------------------------
 # Insertions (verify_network_record + try_insert_network_record)
 # ---------------------------------------------------------------------------
@@ -399,11 +417,15 @@ def insert_vote(p: SimParams, s: Store, weights, v: VoteMsg):
 def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
     """record_store.rs:330-389 (verify) + :500-526 (insert).
 
-    Signature/weight re-verification of the vote list is modeled out: QCs in
-    this framework are only minted by ``check_new_qc`` holding a real quorum,
-    so a QC message is trusted like a valid signature set.  (Divergence note:
-    on a failed state re-execution the reference leaves the QC in its map but
-    skips the computed-value updates; we reject it entirely.)"""
+    Vote-set re-verification on receipt (record_store.rs:371-387): the QC
+    carries its aggregated author-bit mask (``votes_lo/hi``); the receiver
+    checks (a) every masked author is a known index, (b) the masked voting
+    weight reaches quorum, and (c) the QC content tag recomputes from the
+    carried fields *including the mask* — the tag plays the role of the
+    aggregate signature, so a forged mask or tampered field breaks it.
+    (Divergence note: on a failed state re-execution the reference leaves
+    the QC in its map but skips the computed-value updates; we reject it
+    entirely.)"""
     sl = _slot(p, q.round)
     var, is_dup, has_room = _pick_variant(s.qc_valid[sl], s.qc_round[sl], s.qc_tag[sl],
                                           q.round, q.tag)
@@ -418,6 +440,13 @@ def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
     exec_ok, st_d, st_t = compute_state(p, s, q.round, bvar_c)
     state_match = exec_ok & (st_d == q.state_depth) & (st_t == q.state_tag)
     in_window = q.round > s.current_round - p.window
+    vote_w, authors_known = mask_weight(p, weights, q.votes_lo, q.votes_hi)
+    quorum_ok = authors_known & (vote_w >= config.quorum_threshold(weights))
+    tag_ok = q.tag == qc_tag(
+        q.epoch, q.round, q.blk_tag, q.state_depth, q.state_tag,
+        q.commit_valid, q.commit_depth, q.commit_tag,
+        q.votes_lo, q.votes_hi, q.author,
+    )
     ok = (
         q.valid
         & (q.epoch == s.epoch_id)
@@ -428,6 +457,8 @@ def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
         & commit_match
         & state_match
         & in_window
+        & quorum_ok
+        & tag_ok
     )
     var = jnp.maximum(var, 0)
     s2 = s.replace(
@@ -439,6 +470,8 @@ def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
         qc_commit_valid=s.qc_commit_valid.at[sl, var].set(q.commit_valid),
         qc_commit_depth=s.qc_commit_depth.at[sl, var].set(q.commit_depth),
         qc_commit_tag=s.qc_commit_tag.at[sl, var].set(q.commit_tag),
+        qc_votes_lo=s.qc_votes_lo.at[sl, var].set(q.votes_lo),
+        qc_votes_hi=s.qc_votes_hi.at[sl, var].set(q.votes_hi),
         qc_author=s.qc_author.at[sl, var].set(q.author),
         qc_tag=s.qc_tag.at[sl, var].set(q.tag),
     )
@@ -549,7 +582,7 @@ def check_new_qc(p: SimParams, s: Store, weights, author):
         valid=trigger, epoch=s.epoch_id, round=s.current_round,
         blk_tag=s.blk_tag[sl, bvar], state_depth=st_d, state_tag=st_t,
         commit_valid=cs_ok, commit_depth=cs_d, commit_tag=cs_t,
-        author=_i32(author), tag=tag,
+        votes_lo=lo, votes_hi=hi, author=_i32(author), tag=tag,
     )
     s2 = s.replace(election=jnp.where(trigger, _i32(ELECTION_CLOSED), s.election))
     s3, _ = insert_qc(p, s2, weights, q)

@@ -85,6 +85,7 @@ class SimParams:
     lam: float = 0.5          # lambda; fixed-point applied as (lam_fp * d) >> 16
     commit_chain: int = 3     # 3 = LibraBFTv2 3-chain; 2 = HotStuff-style 2-chain
     # Network.
+    inbox_cap: int = 0        # parallel engine per-receiver slots (0 = auto)
     delay_kind: str = "lognormal"
     delay_mean: float = 10.0
     delay_variance: float = 4.0
@@ -102,6 +103,18 @@ class SimParams:
     @property
     def drop_u32(self) -> int:
         return min(int(self.drop_prob * 4294967296.0), 0xFFFFFFFF)
+
+    def structural(self) -> "SimParams":
+        """The compile-relevant projection: fields that only parameterize
+        *data* (delay/duration tables, drop rate, horizon) are normalized to
+        defaults.  Two SimParams with equal ``structural()`` share one
+        compiled step executable — the tables ride in as runtime arguments
+        and max_clock/drop_u32 live in SimState — which is what keeps the
+        test suite's XLA compile count down."""
+        return dataclasses.replace(
+            self, delay_kind="lognormal", delay_mean=10.0, delay_variance=4.0,
+            delay_pareto_scale=5.0, delay_pareto_alpha=1.5, drop_prob=0.0,
+            max_clock=0, delta=20, gamma=2.0)
 
     def delay_table(self) -> np.ndarray:
         if self.delay_kind == "pareto":
@@ -163,8 +176,13 @@ class BlockMsg:
 
 @struct.dataclass
 class QcMsg:
-    """QuorumCertificate_ (/root/reference/librabft-v2/src/record.rs:83-99);
-    the vote list is replaced by a votes digest folded into ``tag``."""
+    """QuorumCertificate_ (/root/reference/librabft-v2/src/record.rs:83-99).
+
+    The vote list is carried as a packed author-bit mask (``votes_lo/hi``,
+    authors 0..63) folded into ``tag``.  Receivers re-verify the vote set on
+    insert — mask weight must reach quorum and the tag must recompute from
+    the carried fields (record_store.rs:330-389) — so a forged QC without a
+    real quorum behind it is rejected, not trusted."""
 
     valid: Array
     epoch: Array
@@ -175,6 +193,8 @@ class QcMsg:
     commit_valid: Array  # bool: committed_state.is_some()
     commit_depth: Array
     commit_tag: Array    # uint32
+    votes_lo: Array      # uint32: author-bit mask, authors 0..31
+    votes_hi: Array      # uint32: authors 32..63
     author: Array
     tag: Array           # uint32
 
@@ -185,6 +205,7 @@ class QcMsg:
             blk_tag=_zeros(shape, jnp.uint32), state_depth=_zeros(shape),
             state_tag=_zeros(shape, jnp.uint32), commit_valid=_zeros(shape, jnp.bool_),
             commit_depth=_zeros(shape), commit_tag=_zeros(shape, jnp.uint32),
+            votes_lo=_zeros(shape, jnp.uint32), votes_hi=_zeros(shape, jnp.uint32),
             author=_zeros(shape), tag=_zeros(shape, jnp.uint32),
         )
 
@@ -300,6 +321,8 @@ class Store:
     qc_commit_valid: Array
     qc_commit_depth: Array
     qc_commit_tag: Array
+    qc_votes_lo: Array     # uint32 author-bit mask of the aggregated votes
+    qc_votes_hi: Array
     qc_author: Array
     qc_tag: Array
     # Votes at the current round, per author [N].
@@ -362,8 +385,9 @@ class Store:
             qc_valid=_zeros(wv, jnp.bool_), qc_round=_zeros(wv), qc_blk_var=_zeros(wv),
             qc_state_depth=_zeros(wv), qc_state_tag=_zeros(wv, jnp.uint32),
             qc_commit_valid=_zeros(wv, jnp.bool_), qc_commit_depth=_zeros(wv),
-            qc_commit_tag=_zeros(wv, jnp.uint32), qc_author=_zeros(wv),
-            qc_tag=_zeros(wv, jnp.uint32),
+            qc_commit_tag=_zeros(wv, jnp.uint32),
+            qc_votes_lo=_zeros(wv, jnp.uint32), qc_votes_hi=_zeros(wv, jnp.uint32),
+            qc_author=_zeros(wv), qc_tag=_zeros(wv, jnp.uint32),
             vt_valid=_zeros(na, jnp.bool_), vt_blk_var=_zeros(na),
             vt_state_depth=_zeros(na), vt_state_tag=_zeros(na, jnp.uint32),
             vt_commit_valid=_zeros(na, jnp.bool_), vt_commit_depth=_zeros(na),
@@ -439,6 +463,9 @@ class Context:
     last_depth: Array
     last_tag: Array           # uint32
     sync_jumps: Array
+    skipped_commits: Array    # depths never delivered to the log: K-tail
+                              # catch-up bypasses + state-sync-jump adoption.
+                              # Invariant: commit_count + skipped == last_depth.
     log_round: Array          # [H]
     log_depth: Array          # [H]
     log_tag: Array            # [H] uint32
@@ -450,7 +477,7 @@ class Context:
             next_cmd_index=_zeros(shape), commit_count=_zeros(shape),
             last_depth=_zeros(shape),
             last_tag=jnp.broadcast_to(H.initial_state_tag(), shape).astype(jnp.uint32),
-            sync_jumps=_zeros(shape),
+            sync_jumps=_zeros(shape), skipped_commits=_zeros(shape),
             log_round=_zeros(h), log_depth=_zeros(h), log_tag=_zeros(h, jnp.uint32),
         )
 
@@ -542,10 +569,13 @@ class SimState:
     weights: Array        # [N] voting rights
     byz_equivocate: Array # [N] bool
     byz_silent: Array     # [N] bool
+    byz_forge_qc: Array   # [N] bool: notifications carry a quorum-less forged hqc
     clock: Array          # global clock
     stamp_ctr: Array      # event/rng counter
     halted: Array         # bool
     seed: Array           # uint32 instance seed
+    max_clock: Array      # i32 horizon (dynamic: doesn't force recompiles)
+    drop_u32: Array       # u32 drop threshold (dynamic)
     # Metrics.
     n_events: Array
     n_msgs_sent: Array

@@ -64,7 +64,7 @@ class NativeResult:
         self.halted = bool(halted)
         (self.n_events, self.clock, self.stamp_ctr, self.n_msgs_sent,
          self.n_msgs_dropped, self.n_queue_full) = (int(x) for x in glob)
-        self.node = node.reshape(p.n_nodes, 7)
+        self.node = node.reshape(p.n_nodes, 8)
         self.log = log.reshape(p.n_nodes, p.commit_log, 3)
 
     def commit_count(self, a):
@@ -84,6 +84,12 @@ class NativeResult:
 
     def hcr(self, a):
         return int(self.node[a, 5])
+
+    def sync_jumps(self, a):
+        return int(self.node[a, 6])
+
+    def skipped_commits(self, a):
+        return int(self.node[a, 7])
 
     def committed_chain(self, a):
         cc = self.commit_count(a)
@@ -108,7 +114,7 @@ def run(p: SimParams, seed: int, weights=None, byz_equivocate=None,
     silent = np.ascontiguousarray(
         byz_silent if byz_silent is not None else np.zeros(n), np.uint8)
     glob = np.zeros(6, np.int64)
-    node = np.zeros(n * 7, np.int64)
+    node = np.zeros(n * 8, np.int64)
     log = np.zeros(n * p.commit_log * 3, np.int64)
     halted = lib.bft_run(
         p.n_nodes, p.window, p.queue_cap, p.chain_k, p.commit_log,

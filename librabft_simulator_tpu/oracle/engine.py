@@ -87,6 +87,23 @@ def quorum_threshold(weights) -> int:
     return 2 * sum(weights) // 3 + 1
 
 
+def mask_weight(n, weights, lo, hi):
+    """(weight, authors_known) of a (lo, hi) author-bit mask — mirrors
+    core/store.py::mask_weight."""
+    w = 0
+    for a in range(n):
+        bit = (lo >> a) & 1 if a < 32 else (hi >> (a - 32)) & 1
+        if bit:
+            w += weights[a]
+    if n >= 64:
+        known = True
+    elif n >= 32:
+        known = (hi >> (n - 32)) == 0
+    else:
+        known = (lo >> n) == 0 and hi == 0
+    return w, known
+
+
 def pick_author(weights, seed_u32: int) -> int:
     target = (seed_u32 & M32) % sum(weights)
     cum = 0
@@ -128,6 +145,8 @@ class QcMsg:
     commit_valid: bool = False
     commit_depth: int = 0
     commit_tag: int = 0
+    votes_lo: int = 0   # author-bit mask of the aggregated votes (0..31)
+    votes_hi: int = 0   # authors 32..63
     author: int = 0
     tag: int = 0
 
@@ -196,7 +215,8 @@ class Store:
         self.qc_valid = zb(); self.qc_round = z(); self.qc_blk_var = z()
         self.qc_state_depth = z(); self.qc_state_tag = z()
         self.qc_commit_valid = zb(); self.qc_commit_depth = z()
-        self.qc_commit_tag = z(); self.qc_author = z(); self.qc_tag = z()
+        self.qc_commit_tag = z(); self.qc_votes_lo = z(); self.qc_votes_hi = z()
+        self.qc_author = z(); self.qc_tag = z()
         self.vt_valid = [False] * N; self.vt_blk_var = [0] * N
         self.vt_state_depth = [0] * N; self.vt_state_tag = [0] * N
         self.vt_commit_valid = [False] * N; self.vt_commit_depth = [0] * N
@@ -443,9 +463,17 @@ class Store:
         exec_ok, st_d, st_t = self.compute_state(q.round, bvar_c)
         state_match = exec_ok and st_d == q.state_depth and st_t == q.state_tag
         in_window = q.round > self.current_round - p.window
+        vote_w, authors_known = mask_weight(p.n_nodes, weights, q.votes_lo,
+                                            q.votes_hi)
+        quorum_ok = authors_known and vote_w >= quorum_threshold(weights)
+        tag_ok = q.tag == fold(
+            TAG_QC, q.epoch & M32, q.round & M32, q.blk_tag,
+            q.state_depth & M32, q.state_tag, int(q.commit_valid) & M32,
+            q.commit_depth & M32, q.commit_tag, q.votes_lo, q.votes_hi,
+            q.author & M32)
         ok = (q.valid and q.epoch == self.epoch_id and not is_dup and has_room
               and bvar >= 0 and author_ok and commit_match and state_match
-              and in_window)
+              and in_window and quorum_ok and tag_ok)
         if not ok:
             return False
         var = max(var, 0)
@@ -457,6 +485,8 @@ class Store:
         self.qc_commit_valid[sl][var] = q.commit_valid
         self.qc_commit_depth[sl][var] = q.commit_depth
         self.qc_commit_tag[sl][var] = q.commit_tag
+        self.qc_votes_lo[sl][var] = q.votes_lo
+        self.qc_votes_hi[sl][var] = q.votes_hi
         self.qc_author[sl][var] = q.author
         self.qc_tag[sl][var] = q.tag
         if q.round > self.hqc_round:
@@ -542,7 +572,7 @@ class Store:
             valid=True, epoch=self.epoch_id, round=self.current_round,
             blk_tag=self.blk_tag[sl][bvar], state_depth=st_d, state_tag=st_t,
             commit_valid=cs_ok, commit_depth=cs_d, commit_tag=cs_t,
-            author=author, tag=tag,
+            votes_lo=lo, votes_hi=hi, author=author, tag=tag,
         )
         self.election = ELECTION_CLOSED
         self.insert_qc(weights, q)

@@ -51,6 +51,7 @@ class Context:
         self.last_depth = 0
         self.last_tag = E.initial_state_tag()
         self.sync_jumps = 0
+        self.skipped_commits = 0
         H = p.commit_log
         self.log_round = [0] * H
         self.log_depth = [0] * H
@@ -187,6 +188,7 @@ def process_commits(p, s: E.Store, nx: NodeExtra, cx: Context, weights):
         cx.log_depth[pos] = d
         cx.log_tag[pos] = t
         cx.commit_count += 1
+        cx.skipped_commits += d - cx.last_depth - 1
         cx.last_depth = d
         cx.last_tag = t
         new_epoch = d // p.commands_per_epoch
@@ -233,6 +235,7 @@ def qc_msg_at(s: E.Store, r, var, valid):
         commit_valid=s.qc_commit_valid[sl][var],
         commit_depth=s.qc_commit_depth[sl][var],
         commit_tag=s.qc_commit_tag[sl][var],
+        votes_lo=s.qc_votes_lo[sl][var], votes_hi=s.qc_votes_hi[sl][var],
         author=s.qc_author[sl][var], tag=s.qc_tag[sl][var],
     )
 
@@ -350,6 +353,7 @@ def handle_response(p, s: E.Store, nx: NodeExtra, cx: Context, weights,
         nx.locked_round = 0
         if (pay.hcc.valid and pay.hcc.commit_valid
                 and pay.hcc.commit_depth > cx.last_depth):
+            cx.skipped_commits += pay.hcc.commit_depth - cx.last_depth
             cx.last_depth = pay.hcc.commit_depth
             cx.last_tag = pay.hcc.commit_tag
         cx.sync_jumps += 1
@@ -388,7 +392,7 @@ class OracleSim:
     """Mirror of sim/simulator.py::step over plain Python state."""
 
     def __init__(self, p: SimParams, seed: int, weights=None,
-                 byz_equivocate=None, byz_silent=None):
+                 byz_equivocate=None, byz_silent=None, byz_forge_qc=None):
         self.p = p
         self.seed = seed & E.M32
         n = p.n_nodes
@@ -398,6 +402,8 @@ class OracleSim:
         self.byz_equivocate = list(byz_equivocate) if byz_equivocate is not None \
             else [False] * n
         self.byz_silent = list(byz_silent) if byz_silent is not None else [False] * n
+        self.byz_forge_qc = list(byz_forge_qc) if byz_forge_qc is not None \
+            else [False] * n
         self.stores = [E.Store(p) for _ in range(n)]
         self.pms = [Pacemaker() for _ in range(n)]
         self.nxs = [NodeExtra() for _ in range(n)]
@@ -438,6 +444,30 @@ class OracleSim:
         s_best = min(s for s, c in zip(stamps, c2) if c)
         idx = next(i for i, (c, s) in enumerate(zip(c2, stamps)) if c and s == s_best)
         return idx, t_min, idx >= cm
+
+    def _forged_qc(self, s: E.Store, author: int, pay: E.Payload) -> E.Payload:
+        """Mirror of sim/simulator.py::_forged_qc_payload."""
+        p = self.p
+        pay2 = copy.deepcopy(pay)
+        bvar = max(s.proposed_var, 0)
+        r = s.current_round
+        sl = s._slot(r)
+        blk_tag_ = s.blk_tag[sl][bvar]
+        own = s.proposed_var >= 0 and s.blk_author[sl][bvar] == author
+        exec_ok, st_d, st_t = s.compute_state(r, bvar)
+        cs_ok, cs_d, cs_t, _ = s.vote_committed_state(r, bvar)
+        lo = (1 << author) & E.M32 if author < 32 else 0
+        hi = (1 << (author - 32)) & E.M32 if author >= 32 else 0
+        tag = E.fold(E.TAG_QC, s.epoch_id & E.M32, r & E.M32, blk_tag_,
+                     st_d & E.M32, st_t, int(cs_ok) & E.M32, cs_d & E.M32,
+                     cs_t, lo, hi, author & E.M32)
+        pay2.hqc = E.QcMsg(
+            valid=bool(own and exec_ok), epoch=s.epoch_id, round=r,
+            blk_tag=blk_tag_, state_depth=st_d, state_tag=st_t,
+            commit_valid=cs_ok, commit_depth=cs_d, commit_tag=cs_t,
+            votes_lo=lo, votes_hi=hi, author=author, tag=tag,
+        )
+        return pay2
 
     def _equivocated(self, pay: E.Payload) -> E.Payload:
         b = pay.prop_blk
@@ -515,9 +545,14 @@ class OracleSim:
 
         # Payload bank (mirrors simulator.py: computed on the post-update store).
         notif = create_notification(p, s, a)
+        if self.byz_forge_qc[a]:
+            notif = self._forged_qc(s, a, notif)
         notif_b = self._equivocated(notif)
         request = create_request(p, s)
         response = handle_request(p, s, a, pay_in)
+        if self.byz_forge_qc[a]:
+            # The tensor path builds the response from the (forged) notif.
+            response.hqc = copy.deepcopy(notif.hqc)
 
         want = [cand0_want] + send_mask + query_mask
         kinds = [cand0_kind] + [KIND_NOTIFY] * n + [KIND_REQUEST] * n

@@ -7,6 +7,10 @@ The attack surface is wired into the core simulator
   command, different hash) to the upper half of receivers — classic
   equivocation.  The V=2 variant tables make the conflict observable.
 * ``byz_silent[a]``: node *a* crashes (never sends; still receives).
+* ``byz_forge_qc[a]``: node *a*'s notifications carry a quorum-less forged
+  QC on its own proposal (self-consistent tag, author-mask = itself);
+  honest receivers reject it in ``insert_qc``'s vote-set re-verification
+  (record_store.rs:371-387).
 
 This module builds fault-masked fleets, runs f-sweeps, and checks the safety
 invariant: no two honest nodes commit different state tags at the same depth
@@ -27,7 +31,8 @@ from . import simulator as S
 
 
 def byz_masks(p: SimParams, f: int, kind: str = "equivocate", authors=None):
-    """Masks marking ``f`` authors as faulty (default: the first ``f``).
+    """(equivocate, silent, forge_qc) masks marking ``f`` authors as faulty
+    (default: the first ``f``).
 
     ``authors`` overrides which indices are faulty.  Note the leader schedule
     (config.leader_of_round) is a fixed pseudorandom sequence, so *which*
@@ -38,25 +43,62 @@ def byz_masks(p: SimParams, f: int, kind: str = "equivocate", authors=None):
     m = np.isin(idx, np.asarray(authors)) if authors is not None else idx < f
     eq = m if kind == "equivocate" else np.zeros_like(m)
     silent = m if kind == "silent" else np.zeros_like(m)
-    return jnp.asarray(eq), jnp.asarray(silent)
+    forge = m if kind == "forge_qc" else np.zeros_like(m)
+    return jnp.asarray(eq), jnp.asarray(silent), jnp.asarray(forge)
 
 
 def init_fault_batch(p: SimParams, seeds, f: int, kind: str = "equivocate",
                      authors=None):
-    eq, silent = byz_masks(p, f, kind, authors)
+    eq, silent, forge = byz_masks(p, f, kind, authors)
     seeds = jnp.asarray(seeds).astype(jnp.uint32)
     return jax.vmap(
-        lambda s: S.init_state(p, s, byz_equivocate=eq, byz_silent=silent)
+        lambda s: S.init_state(p, s, byz_equivocate=eq, byz_silent=silent,
+                               byz_forge_qc=forge)
     )(seeds)
+
+
+@jax.jit
+def _safety_device(log_depth, log_tag, commit_count, honest):
+    """Device-side agreement reduction: sort each instance's (depth, tag)
+    commit entries lexicographically; a violation is two adjacent entries
+    with equal depth and different tags.  O(NH log NH) per instance instead
+    of the Python triple loop — this is what makes config #4's 10k-instance
+    f-sweep checkable (simulated_context.rs:220 committed-history
+    agreement)."""
+    B, N, H = log_depth.shape
+    valid = (jnp.arange(H)[None, None, :]
+             < jnp.minimum(commit_count, H)[:, :, None]) & honest[None, :, None]
+    depth = log_depth.reshape(B, N * H)
+    tag = log_tag.reshape(B, N * H)
+    v = valid.reshape(B, N * H)
+    # Invalid entries get unique negative depths so they never collide.
+    uniq = -1 - jnp.arange(N * H, dtype=jnp.int32)
+    depth = jnp.where(v, depth, uniq[None, :])
+    order = jnp.lexsort((tag, depth), axis=-1)
+    d_s = jnp.take_along_axis(depth, order, axis=-1)
+    t_s = jnp.take_along_axis(tag, order, axis=-1)
+    conflict = (d_s[:, 1:] == d_s[:, :-1]) & (t_s[:, 1:] != t_s[:, :-1])
+    return ~jnp.any(conflict, axis=-1)
 
 
 def check_safety(st, honest_mask=None):
     """Per-instance safety: across nodes, committed tags agree at equal depth.
 
-    Works on a batched SimState ([B] leading dim).  Returns a bool [B] array:
-    True = safe.  Comparison covers the ring log (the last ``commit_log``
-    commits of each node), which bounds memory like the rest of the design.
-    """
+    Works on a batched SimState/PSimState ([B] leading dim).  Returns a bool
+    [B] numpy array: True = safe.  Comparison covers the ring log (the last
+    ``commit_log`` commits of each node), which bounds memory like the rest
+    of the design.  Runs on device (see ``_safety_device``)."""
+    N = st.ctx.log_depth.shape[1]
+    if honest_mask is None:
+        honest_mask = np.ones((N,), bool)
+    safe = _safety_device(st.ctx.log_depth, st.ctx.log_tag,
+                          st.ctx.commit_count, jnp.asarray(honest_mask))
+    return np.asarray(jax.device_get(safe))
+
+
+def check_safety_reference(st, honest_mask=None):
+    """Pure-Python reference of :func:`check_safety` (kept for testing the
+    device reduction)."""
     log_depth = np.asarray(jax.device_get(st.ctx.log_depth))  # [B, N, H]
     log_tag = np.asarray(jax.device_get(st.ctx.log_tag))
     commit_count = np.asarray(jax.device_get(st.ctx.commit_count))  # [B, N]

@@ -22,12 +22,17 @@ what makes 64-node fleets (BASELINE config #3) tractable.
 
 Determinism: rng/stamps are node-local counters (stamp stream ``ctr*N+n``),
 so trajectories are bit-reproducible for a seed (CPU == TPU), independent of
-how many nodes happen to share a window.  They are NOT the serial engine's
-trajectories (different stamp interleaving) — the serial engine remains the
-oracle-parity reference; this engine has its own determinism/safety tests.
+how many nodes happen to share a window — ``tests/test_parallel_sim.py``
+asserts this bit-exactly by shrinking the lookahead.  They are NOT the serial
+engine's trajectories (different stamp interleaving): the serial engine
+remains the oracle-parity reference, and the same test file checks this
+engine statistically against it (commit/event density per unit virtual time)
+plus safety under Byzantine masks and inbox-overflow accounting.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +40,7 @@ import numpy as np
 from flax import struct
 
 from ..core import data_sync, node as node_ops, store as store_ops
+from .simulator import _forged_qc_payload
 from ..core.types import (
     KIND_NOTIFY,
     KIND_REQUEST,
@@ -71,6 +77,9 @@ class PSimState:
     node: NodeExtra       # [N]
     ctx: Context          # [N, ...]
     # Per-receiver inboxes.
+    byz_forge_qc: jnp.ndarray
+    max_clock: jnp.ndarray   # i32 horizon (dynamic; see SimParams.structural)
+    drop_u32: jnp.ndarray    # u32 drop threshold (dynamic)
     in_valid: jnp.ndarray    # [N, IC] bool
     in_time: jnp.ndarray     # [N, IC]
     in_kind: jnp.ndarray     # [N, IC]
@@ -98,11 +107,15 @@ def d_min_of(p: SimParams) -> int:
 
 
 def inbox_cap(p: SimParams) -> int:
-    return max(16, 4 * p.n_nodes)
+    """Per-receiver inbox slots: ``SimParams.inbox_cap`` if set, else 4 per
+    peer.  Memory scales O(n) per node vs the serial engine's shared queue,
+    which needs O(n^2)-ish capacity to stay lossless (in-flight broadcasts ~
+    n*(n-1)*mean_delay/round_duration)."""
+    return p.inbox_cap if p.inbox_cap > 0 else max(16, 4 * p.n_nodes)
 
 
 def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
-               byz_silent=None) -> PSimState:
+               byz_silent=None, byz_forge_qc=None) -> PSimState:
     n = p.n_nodes
     ic = inbox_cap(p)
     F = payload_width(p)
@@ -116,6 +129,8 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         byz_equivocate = jnp.zeros((n,), jnp.bool_)
     if byz_silent is None:
         byz_silent = jnp.zeros((n,), jnp.bool_)
+    if byz_forge_qc is None:
+        byz_forge_qc = jnp.zeros((n,), jnp.bool_)
     return PSimState(
         store=Store.initial(p, (n,)),
         pm=Pacemaker.initial((n,)),
@@ -132,6 +147,9 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         weights=jnp.asarray(weights, I32),
         byz_equivocate=jnp.asarray(byz_equivocate, jnp.bool_),
         byz_silent=jnp.asarray(byz_silent, jnp.bool_),
+        byz_forge_qc=jnp.asarray(byz_forge_qc, jnp.bool_),
+        max_clock=_i32(p.max_clock),
+        drop_u32=jnp.uint32(p.drop_u32),
         clock=_i32(0),
         node_ctr=jnp.ones((n,), I32),
         halted=jnp.bool_(False),
@@ -165,21 +183,33 @@ def _node_earliest(p, st):
 
 
 def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
-    """One window: every node whose earliest event falls in
-    [t_min, t_min + d_min) processes that event."""
+    """One window: every node whose earliest event falls below its *own*
+    lookahead horizon processes that event.
+
+    Per-node horizon (Chandy-Misra): node ``a`` may safely process any event
+    strictly earlier than ``min_{b != a} t_ev[b] + d_min`` — the earliest time
+    a message emitted by any other node's pending work could reach it.  This
+    is strictly wider than the classic global window ``[t_min, t_min+d_min)``
+    (a node ahead of the pack keeps draining its backlog instead of idling),
+    which directly raises window occupancy = useful events per step.  The
+    min-excluding-self is computed from the global min and second-min."""
     n = p.n_nodes
     ic = inbox_cap(p)
     F = payload_width(p)
 
     t_ev, k_ev, slot, is_timer = _node_earliest(p, st)
     t_min = jnp.min(t_ev)
-    halt = st.halted | (t_min > p.max_clock)
+    halt = st.halted | (t_min > st.max_clock)
     live = ~halt
     clock = jnp.maximum(st.clock, jnp.minimum(t_min, NEVER - 1))
-    active = live & (t_ev < jnp.minimum(t_min + d_min, NEVER))  # [N]
+    uniq_min = jnp.sum(t_ev == t_min) == 1
+    t_second = jnp.min(jnp.where(t_ev == t_min, NEVER, t_ev))
+    min_excl_self = jnp.where((t_ev == t_min) & uniq_min, t_second, t_min)
+    horizon = jnp.minimum(min_excl_self, NEVER - d_min) + d_min  # [N]
+    active = live & (t_ev < horizon)  # [N]
     # Never process events beyond max_clock inside a window that started
     # before it (they halt the next step).
-    active = active & (t_ev <= p.max_clock)
+    active = active & (t_ev <= st.max_clock)
 
     slot_c = jnp.maximum(slot, 0)
     pay_rows = jnp.take_along_axis(st.in_pay, slot_c[:, None, None], axis=1)[:, 0]
@@ -211,6 +241,8 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         nx_f = store_ops._sel(do_update[a], nx_u, nx_in)
         cx_f = store_ops._sel(do_update[a], cx_u, cx_in)
         notif = data_sync.create_notification(p, s_f, a)
+        notif = store_ops._sel(st.byz_forge_qc[a],
+                               _forged_qc_payload(p, s_f, a, notif), notif)
         request = data_sync.create_request(p, s_f)
         response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
         notif_p = pack_payload(notif)
@@ -270,7 +302,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     u_drop = H.mix32(u_delay, jnp.uint32(0x632BE59B))
     delays = jnp.maximum(delay_table[(u_delay >> (32 - TABLE_BITS)).astype(I32)],
                          d_min)
-    dropped = want & (u_drop < jnp.uint32(p.drop_u32))
+    dropped = want & (u_drop < st.drop_u32)
     arrive = t_ev[:, None] + delays  # sender's event time + latency
     go = want & ~dropped
 
@@ -344,21 +376,37 @@ def _equivocate(p: SimParams, pay):
     )
 
 
-def make_run_fn(p: SimParams, num_steps: int, batched: bool = True):
-    delay_table = jnp.asarray(p.delay_table())
-    dur_table = jnp.asarray(p.duration_table())
-    dmin = d_min_of(p)
-
-    def run(st):
+@functools.lru_cache(maxsize=None)
+def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
+    def run(delay_table, dur_table, d_min, st):
         def body(s, _):
-            return step(p, delay_table, dur_table, dmin, s), ()
+            return step(p_structural, delay_table, dur_table, d_min, s), ()
 
         st, _ = jax.lax.scan(body, st, None, length=num_steps)
         return st
 
     if batched:
-        run = jax.vmap(run)
-    return jax.jit(run, donate_argnums=(0,))
+        run = jax.vmap(run, in_axes=(None, None, None, 0))
+    return jax.jit(run, donate_argnums=(3,))
+
+
+def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
+                d_min: int | None = None):
+    """``d_min`` overrides the lookahead (must be <= the true minimum message
+    latency).  As long as no inbox overflows, any conservative value yields
+    the SAME trajectories — narrower windows only mean more steps — which
+    `tests/test_parallel_sim.py` asserts bit-exactly.  (Under overflow the
+    window width changes which concurrent sends compete for free slots, so
+    the discarded set — and hence the trajectory — may differ.)  The
+    executable is memoized on ``p.structural()`` with the lookahead as a
+    runtime scalar, so delay/drop/horizon variants share one compile."""
+    dmin = d_min_of(p) if d_min is None else d_min
+    assert 1 <= dmin <= d_min_of(p), (dmin, d_min_of(p))
+    inner = _compiled_run(p.structural(), num_steps, batched)
+    delay_table = jnp.asarray(p.delay_table())
+    dur_table = jnp.asarray(p.duration_table())
+    dmin_arr = jnp.asarray(dmin, I32)
+    return lambda st: inner(delay_table, dur_table, dmin_arr, st)
 
 
 def init_batch(p: SimParams, seeds) -> PSimState:

@@ -65,7 +65,8 @@ def _node_update(tree, a, new):
 
 
 def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
-               byz_equivocate=None, byz_silent=None) -> SimState:
+               byz_equivocate=None, byz_silent=None,
+               byz_forge_qc=None) -> SimState:
     """Simulator::new (simulator.rs:200-250): per-node random startup times,
     initial timers at local time 0."""
     n = p.n_nodes
@@ -79,6 +80,8 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         byz_equivocate = jnp.zeros((n,), jnp.bool_)
     if byz_silent is None:
         byz_silent = jnp.zeros((n,), jnp.bool_)
+    if byz_forge_qc is None:
+        byz_forge_qc = jnp.zeros((n,), jnp.bool_)
     return SimState(
         store=Store.initial(p, (n,)),
         pm=Pacemaker.initial((n,)),
@@ -91,10 +94,13 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         weights=jnp.asarray(weights, I32),
         byz_equivocate=jnp.asarray(byz_equivocate, jnp.bool_),
         byz_silent=jnp.asarray(byz_silent, jnp.bool_),
+        byz_forge_qc=jnp.asarray(byz_forge_qc, jnp.bool_),
         clock=_i32(0),
         stamp_ctr=_i32(n),
         halted=jnp.bool_(False),
         seed=seed,
+        max_clock=_i32(p.max_clock),
+        drop_u32=jnp.uint32(p.drop_u32),
         n_events=_i32(0),
         n_msgs_sent=_i32(0),
         n_msgs_dropped=_i32(0),
@@ -135,11 +141,43 @@ def _equivocated_payload(p: SimParams, s_a, author, pay: Payload) -> Payload:
     )
 
 
+def _forged_qc_payload(p: SimParams, s_a, author, pay: Payload) -> Payload:
+    """Quorum-less forged QC for Byzantine sweeps: the attacker claims a QC on
+    its own current-round proposal backed only by its own vote (author-bit
+    mask = {author}), with a self-consistent content tag.  Every other insert
+    check passes at the receiver, so this isolates the vote-set
+    re-verification (insert_qc ``quorum_ok``) as the rejecting predicate —
+    the attack the reference's per-vote checks exist to stop
+    (record_store.rs:371-387)."""
+    author = jnp.asarray(author, I32)
+    bvar = jnp.maximum(s_a.proposed_var, 0)
+    r = s_a.current_round
+    sl = jnp.remainder(r, p.window)
+    blk_tag_ = s_a.blk_tag[sl, bvar]
+    own = (s_a.proposed_var >= 0) & (s_a.blk_author[sl, bvar] == author)
+    exec_ok, st_d, st_t = store_ops.compute_state(p, s_a, r, bvar)
+    cs_ok, cs_d, cs_t, _ = store_ops.vote_committed_state(p, s_a, r, bvar)
+    lo = jnp.where(author < 32, jnp.uint32(1) << author.astype(jnp.uint32),
+                   jnp.uint32(0))
+    hi = jnp.where(author >= 32,
+                   jnp.uint32(1) << jnp.maximum(author - 32, 0).astype(jnp.uint32),
+                   jnp.uint32(0))
+    tag = store_ops.qc_tag(s_a.epoch_id, r, blk_tag_, st_d, st_t,
+                           cs_ok, cs_d, cs_t, lo, hi, author)
+    forged = pay.hqc.replace(
+        valid=own & exec_ok, epoch=s_a.epoch_id, round=r, blk_tag=blk_tag_,
+        state_depth=st_d, state_tag=st_t,
+        commit_valid=cs_ok, commit_depth=cs_d, commit_tag=cs_t,
+        votes_lo=lo, votes_hi=hi, author=author, tag=tag,
+    )
+    return pay.replace(hqc=forged)
+
+
 def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     """Process one event of one instance (loop_until body, simulator.rs:380-468)."""
     n, cm, k_chain = p.n_nodes, p.queue_cap, p.chain_k
     idx, t_min, is_timer = _select_event(p, st)
-    halt = st.halted | (t_min > p.max_clock)
+    halt = st.halted | (t_min > st.max_clock)
     live = ~halt
     clock = jnp.maximum(st.clock, jnp.minimum(t_min, NEVER - 1))
     midx = jnp.minimum(idx, cm - 1)
@@ -182,6 +220,8 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
 
     # ---- Outgoing messages.
     notif = data_sync.create_notification(p, s_f, a)
+    notif = store_ops._sel(st.byz_forge_qc[a],
+                           _forged_qc_payload(p, s_f, a, notif), notif)
     notif_b = _equivocated_payload(p, s_f, a, notif)
     request = data_sync.create_request(p, s_f)
     response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
@@ -229,7 +269,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     u_delay = jax.vmap(lambda c: H.rng_u32(st.seed, c.astype(jnp.uint32)))(stamps)
     u_drop = jax.vmap(lambda c: H.mix32(c, jnp.uint32(0x632BE59B)))(u_delay)
     delays = delay_table[(u_delay >> (32 - TABLE_BITS)).astype(I32)]
-    dropped = want & (u_drop < jnp.uint32(p.drop_u32))
+    dropped = want & (u_drop < st.drop_u32)
     arrive = clock + delays
 
     # Free-slot assignment.
@@ -306,31 +346,55 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_step(p_structural: SimParams, batched: bool):
+    f = functools.partial(step, p_structural)
+    if batched:
+        f = jax.vmap(f, in_axes=(None, None, 0))
+    # Tables are arguments (not baked constants): one executable serves every
+    # delay/drop/max_clock config with this structural shape.
+    return jax.jit(lambda dt, du, st: f(dt, du, st), donate_argnums=(2,))
+
+
 def make_step_fn(p: SimParams, batched: bool = True):
     """Compiled step over a [B, ...] batch of instances."""
+    inner = _compiled_step(p.structural(), batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
-    f = functools.partial(step, p, delay_table, dur_table)
-    if batched:
-        f = jax.vmap(f)
-    return jax.jit(f, donate_argnums=(0,))
+    return lambda st: inner(delay_table, dur_table, st)
 
 
-def make_run_fn(p: SimParams, num_steps: int, batched: bool = True):
-    """lax.scan of ``num_steps`` events per instance (loop_until)."""
+def step_fn_partial(p: SimParams):
+    """Uncompiled single-instance step with tables bound (for callers that
+    wrap it in their own transforms)."""
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
+    return functools.partial(step, p, delay_table, dur_table)
 
-    def run(st):
+
+@functools.lru_cache(maxsize=None)
+def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
+    def run(delay_table, dur_table, st):
         def body(s, _):
-            return step(p, delay_table, dur_table, s), ()
+            return step(p_structural, delay_table, dur_table, s), ()
 
         st, _ = jax.lax.scan(body, st, None, length=num_steps)
         return st
 
     if batched:
-        run = jax.vmap(run)
-    return jax.jit(run, donate_argnums=(0,))
+        run = jax.vmap(run, in_axes=(None, None, 0))
+    return jax.jit(run, donate_argnums=(2,))
+
+
+def make_run_fn(p: SimParams, num_steps: int, batched: bool = True):
+    """lax.scan of ``num_steps`` events per instance (loop_until).
+
+    The jitted executable is memoized on ``p.structural()`` — calls for
+    params differing only in delay/drop/horizon reuse one compile."""
+    inner = _compiled_run(p.structural(), num_steps, batched)
+    delay_table = jnp.asarray(p.delay_table())
+    dur_table = jnp.asarray(p.duration_table())
+    return lambda st: inner(delay_table, dur_table, st)
 
 
 def dedupe_buffers(st):
@@ -339,8 +403,8 @@ def dedupe_buffers(st):
     return jax.tree.map(lambda x: jnp.array(x, copy=True), st)
 
 
-def run_to_completion(p: SimParams, st: SimState, chunk: int = 512,
-                      max_chunks: int = 200, batched: bool = False):
+def run_to_completion(p: SimParams, st: SimState, chunk: int = 256,
+                      max_chunks: int = 400, batched: bool = False):
     """Host loop: run until every instance passes max_clock (for tests)."""
     run = make_run_fn(p, chunk, batched=batched)
     st = dedupe_buffers(st)

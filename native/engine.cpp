@@ -76,7 +76,8 @@ struct BlockMsg {
 struct QcMsg {
   bool valid = false, commit_valid = false;
   int epoch = 0, round = 0, state_depth = 0, commit_depth = 0, author = 0;
-  u32 blk_tag = 0, state_tag = 0, commit_tag = 0, tag = 0;
+  u32 blk_tag = 0, state_tag = 0, commit_tag = 0, votes_lo = 0, votes_hi = 0,
+      tag = 0;
 };
 
 struct VoteMsg {
@@ -139,7 +140,8 @@ struct Store {
   std::vector<int> blk_round, blk_author, blk_prev_round, blk_time,
       blk_cmd_proposer, blk_cmd_index, qc_round, qc_blk_var, qc_state_depth,
       qc_commit_depth, qc_author;
-  std::vector<u32> blk_prev_tag, blk_tag, qc_state_tag, qc_commit_tag, qc_tag;
+  std::vector<u32> blk_prev_tag, blk_tag, qc_state_tag, qc_commit_tag,
+      qc_votes_lo, qc_votes_hi, qc_tag;
   // per-author
   std::vector<uint8_t> vt_valid, vt_commit_valid, to_valid, tc_valid;
   std::vector<int> vt_blk_var, vt_state_depth, vt_commit_depth, to_hcbr,
@@ -169,7 +171,8 @@ struct Store {
     zu(blk_tag);
     zb(qc_valid); zi(qc_round); zi(qc_blk_var); zi(qc_state_depth);
     zu(qc_state_tag); zb(qc_commit_valid); zi(qc_commit_depth);
-    zu(qc_commit_tag); zi(qc_author); zu(qc_tag);
+    zu(qc_commit_tag); zu(qc_votes_lo); zu(qc_votes_hi);
+    zi(qc_author); zu(qc_tag);
     vt_valid.assign(N, 0); vt_blk_var.assign(N, 0);
     vt_state_depth.assign(N, 0); vt_state_tag.assign(N, 0);
     vt_commit_valid.assign(N, 0); vt_commit_depth.assign(N, 0);
@@ -441,15 +444,36 @@ struct Store {
     bool exec_ok = compute_state(q.round, bvar_c, st_d, st_t);
     bool state_match = exec_ok && st_d == q.state_depth && st_t == q.state_tag;
     bool in_window = q.round > current_round - p.window;
+    // Vote-set re-verification (record_store.rs:371-387): masked authors
+    // must be known, their weight must reach quorum, and the tag must
+    // recompute from the carried fields including the mask.
+    int vote_w = 0;
+    for (int a = 0; a < p.n_nodes; a++) {
+      u32 bit = a < 32 ? (q.votes_lo >> a) & 1u : (q.votes_hi >> (a - 32)) & 1u;
+      if (bit) vote_w += w[a];
+    }
+    bool known = p.n_nodes >= 64 ||
+                 (p.n_nodes >= 32 ? (q.votes_hi >> (p.n_nodes - 32)) == 0
+                                  : ((q.votes_lo >> p.n_nodes) == 0 &&
+                                     q.votes_hi == 0));
+    bool quorum_ok = known && vote_w >= quorum_threshold(w);
+    bool tag_ok =
+        q.tag == fold(TAG_QC, (u32)q.epoch, (u32)q.round, q.blk_tag,
+                      (u32)q.state_depth, q.state_tag,
+                      (u32)(q.commit_valid ? 1 : 0), (u32)q.commit_depth,
+                      q.commit_tag, q.votes_lo, q.votes_hi, (u32)q.author);
     bool ok = q.valid && q.epoch == epoch_id && !dup && room && bvar >= 0 &&
-              author_ok && commit_match && state_match && in_window;
+              author_ok && commit_match && state_match && in_window &&
+              quorum_ok && tag_ok;
     if (!ok) return false;
     var = std::max(var, 0);
     int k = ix(sl, var);
     qc_valid[k] = 1; qc_round[k] = q.round; qc_blk_var[k] = bvar_c;
     qc_state_depth[k] = q.state_depth; qc_state_tag[k] = q.state_tag;
     qc_commit_valid[k] = q.commit_valid; qc_commit_depth[k] = q.commit_depth;
-    qc_commit_tag[k] = q.commit_tag; qc_author[k] = q.author; qc_tag[k] = q.tag;
+    qc_commit_tag[k] = q.commit_tag;
+    qc_votes_lo[k] = q.votes_lo; qc_votes_hi[k] = q.votes_hi;
+    qc_author[k] = q.author; qc_tag[k] = q.tag;
     if (q.round > hqc_round) { hqc_round = q.round; hqc_var = var; }
     update_current_round(q.round + 1);
     update_commit_chain(q.round, var);
@@ -544,6 +568,7 @@ struct Store {
     q.blk_tag = blk_tag[ix(sl, bvar)];
     q.state_depth = st_d; q.state_tag = st_t;
     q.commit_valid = cs_ok; q.commit_depth = cs_d; q.commit_tag = cs_t;
+    q.votes_lo = lo; q.votes_hi = hi;
     q.author = author; q.tag = tag;
     election = EL_CLOSED;
     insert_qc(w, q);
@@ -581,7 +606,8 @@ struct NodeExtra {
 };
 
 struct Context {
-  int next_cmd_index = 0, commit_count = 0, last_depth = 0, sync_jumps = 0;
+  int next_cmd_index = 0, commit_count = 0, last_depth = 0, sync_jumps = 0,
+      skipped_commits = 0;
   u32 last_tag = initial_state_tag();
   std::vector<int> log_round, log_depth;
   std::vector<u32> log_tag;
@@ -711,6 +737,7 @@ struct Engine {
       cx.log_depth[pos] = c.depth;
       cx.log_tag[pos] = c.tag;
       cx.commit_count++;
+      cx.skipped_commits += c.depth - cx.last_depth - 1;
       cx.last_depth = c.depth;
       cx.last_tag = c.tag;
       int new_epoch = c.depth / p.commands_per_epoch;
@@ -807,6 +834,7 @@ struct Engine {
     q.blk_tag = s.blk_tag[bk]; q.state_depth = s.qc_state_depth[k];
     q.state_tag = s.qc_state_tag[k]; q.commit_valid = s.qc_commit_valid[k];
     q.commit_depth = s.qc_commit_depth[k]; q.commit_tag = s.qc_commit_tag[k];
+    q.votes_lo = s.qc_votes_lo[k]; q.votes_hi = s.qc_votes_hi[k];
     q.author = s.qc_author[k]; q.tag = s.qc_tag[k];
     return q;
   }
@@ -929,6 +957,7 @@ struct Engine {
       nx.locked_round = 0;
       if (pay.hcc.valid && pay.hcc.commit_valid &&
           pay.hcc.commit_depth > cx.last_depth) {
+        cx.skipped_commits += pay.hcc.commit_depth - cx.last_depth;
         cx.last_depth = pay.hcc.commit_depth;
         cx.last_tag = pay.hcc.commit_tag;
       }
@@ -1135,8 +1164,8 @@ struct Engine {
 extern "C" {
 
 // Flat result layout per node: commit_count, last_depth, last_tag,
-// current_round, hqc_round, hcr, sync_jumps  (7 i64 each), then the commit
-// ring: commit_log * 3 entries (round, depth, tag) per node.
+// current_round, hqc_round, hcr, sync_jumps, skipped_commits (8 i64 each),
+// then the commit ring: commit_log * 3 entries (round, depth, tag) per node.
 int bft_run(
     // params
     int n_nodes, int window, int queue_cap, int chain_k, int commit_log,
@@ -1170,7 +1199,7 @@ int bft_run(
   for (int a = 0; a < n_nodes; a++) {
     const Store& s = e.stores[a];
     const Context& c = e.ctxs[a];
-    i64* o = node_out + a * 7;
+    i64* o = node_out + a * 8;
     o[0] = c.commit_count;
     o[1] = c.last_depth;
     o[2] = c.last_tag;
@@ -1178,6 +1207,7 @@ int bft_run(
     o[4] = s.hqc_round;
     o[5] = s.hcr;
     o[6] = c.sync_jumps;
+    o[7] = c.skipped_commits;
     for (int i = 0; i < commit_log; i++) {
       i64* l = log_out + (a * commit_log + i) * 3;
       l[0] = c.log_round[i];

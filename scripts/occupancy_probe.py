@@ -1,0 +1,87 @@
+"""Dev probe: window occupancy + throughput of the parallel engine vs serial.
+
+Run on CPU: JAX_PLATFORMS=cpu python scripts/occupancy_probe.py
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import parallel_sim as P
+from librabft_simulator_tpu.sim import simulator as S
+from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+
+
+def probe(engine, name, p, B=512, chunk=32, reps=3):
+    seeds = np.arange(B, dtype=np.uint32)
+    st = dedupe_buffers(engine.init_batch(p, seeds))
+    run = engine.make_run_fn(p, chunk)
+    t0 = time.perf_counter()
+    st = run(st)
+    jax.block_until_ready(st)
+    compile_s = time.perf_counter() - t0
+    e0 = int(np.sum(jax.device_get(st.n_events)))
+    r0 = int(np.sum(np.max(jax.device_get(st.store.current_round), axis=-1) - 1))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st = run(st)
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+    e1 = int(np.sum(jax.device_get(st.n_events)))
+    r1 = int(np.sum(np.max(jax.device_get(st.store.current_round), axis=-1) - 1))
+    steps = chunk * reps * B
+    print(f"{name:10s} ev/s={(e1-e0)/dt:10.0f} rounds/s={(r1-r0)/dt:8.0f} "
+          f"occupancy={(e1-e0)/steps:5.2f} compile={compile_s:5.1f}s dt={dt:.2f}s")
+
+
+def ablate(name):
+    """Stub out one piece of the step machinery to attribute cost.
+    Trajectories become WRONG; timing-only."""
+    from librabft_simulator_tpu.core import data_sync as ds
+    from librabft_simulator_tpu.core import node as node_ops
+
+    if name == "response":
+        ds.handle_response = lambda p, s, nx, cx, w, pay: (s, nx, cx)
+    elif name == "notification":
+        import jax.numpy as jnp
+        ds.handle_notification = lambda p, s, w, pay: (s, jnp.bool_(False))
+    elif name == "request":
+        ds.handle_request = lambda p, s, a, req, notif=None: (
+            notif if notif is not None else ds.create_notification(p, s, a))
+    elif name == "commits":
+        node_ops.process_commits = lambda p, s, nx, ctx, w: (s, nx, ctx)
+    elif name == "update":
+        def _stub_update(p, s, pm, nx, cx, w, a, clock, dur):
+            import jax.numpy as jnp
+            n = p.n_nodes
+            return s, pm, nx, cx, node_ops.NodeUpdateActions(
+                next_sched=jnp.asarray(clock + 10, jnp.int32),
+                send_mask=jnp.zeros((n,), jnp.bool_),
+                should_query_all=jnp.bool_(False))
+        node_ops.update_node = _stub_update
+    elif name:
+        raise ValueError(name)
+
+
+if __name__ == "__main__":
+    n = int(os.environ.get("PN", "4"))
+    B = int(os.environ.get("PB", "512"))
+    ab = os.environ.get("ABLATE", "")
+    engines = os.environ.get("ENGINES", "parallel,serial").split(",")
+    ablate(ab)
+    p = SimParams(n_nodes=n, delay_kind="uniform", max_clock=2**30,
+                  queue_cap=max(32, 4 * n))
+    for e in engines:
+        probe({"parallel": P, "serial": S}[e], f"{e}{'/' + ab if ab else ''}",
+              p, B=B)

@@ -9,6 +9,7 @@ Author 0 leads rounds 2,5,7,8,9,10,12, so making it faulty defers commits
 past clock ~10k: those configs assert safety only.
 """
 
+import jax.numpy as jnp
 import numpy as np
 
 from librabft_simulator_tpu.core.types import SimParams
@@ -57,6 +58,40 @@ def test_f_sweep_structure():
     for r in res:
         assert r.safe_fraction == 1.0
     assert res[0].live_fraction == 1.0
+
+
+def test_device_safety_checker_matches_reference():
+    """The device-side sort-reduction == the Python triple loop, on a real
+    Byzantine batch AND on a state with an injected conflict."""
+    p = SimParams(n_nodes=4, max_clock=1200)
+    st = run_fleet(p, 12, f=1, kind="equivocate", authors=[3])
+    honest = np.arange(4) != 3
+    np.testing.assert_array_equal(B.check_safety(st, honest),
+                                  B.check_safety_reference(st, honest))
+    np.testing.assert_array_equal(B.check_safety(st),
+                                  B.check_safety_reference(st))
+    # Inject a conflicting tag at an equal depth into instance 0, node 1.
+    log_tag = np.asarray(st.ctx.log_tag).copy()
+    log_depth = np.asarray(st.ctx.log_depth).copy()
+    cc = np.asarray(st.ctx.commit_count)
+    b = int(np.argmax(cc[:, 1] > 0))
+    assert cc[b, 1] > 0 and cc[b, 2] > 0
+    log_depth[b, 1, 0] = log_depth[b, 2, 0]
+    log_tag[b, 1, 0] = log_tag[b, 2, 0] ^ 1
+    st2 = st.replace(ctx=st.ctx.replace(
+        log_tag=jnp.asarray(log_tag), log_depth=jnp.asarray(log_depth)))
+    got = B.check_safety(st2, honest)
+    ref = B.check_safety_reference(st2, honest)
+    np.testing.assert_array_equal(got, ref)
+    assert not got[b]
+
+
+def test_forge_qc_sweep_safe():
+    """config #4 with the forge_qc attacker: sweep stays safe."""
+    p = SimParams(n_nodes=4, max_clock=800)
+    res = B.f_sweep(p, n_instances=8, f_values=[0, 1], kind="forge_qc")
+    for r in res:
+        assert r.safe_fraction == 1.0
 
 
 def test_too_many_silent_loses_liveness_not_safety():

@@ -87,6 +87,13 @@ def test_parity_byzantine_equivocate():
     assert_parity(p, 17, byz_equivocate=eq)
 
 
+def test_parity_byzantine_forge_qc():
+    p = SimParams(n_nodes=4, max_clock=1000)
+    forge = np.asarray([True, False, False, False])
+    st, orc = assert_parity(p, 29, byz_forge_qc=forge)
+    assert max(int(c) for c in st.ctx.commit_count) > 0
+
+
 def test_parity_small_window_forces_jumps():
     p = SimParams(n_nodes=3, max_clock=2000, window=8, chain_k=2, drop_prob=0.1)
     st, orc = assert_parity(p, 19)
