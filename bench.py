@@ -10,19 +10,24 @@ on a single line of stdout.  ``vs_baseline`` is against the reference north
 star of >=1M consensus rounds/sec aggregate (BASELINE.json).
 
 Platform handling (the part that decides whether this file produces a number
-at all): the environment's TPU plugin can HANG backend init indefinitely when
-the TPU tunnel is down and it ignores ``JAX_PLATFORMS``.  So before touching
-any backend in-process we probe the default backend in a *subprocess with a
-timeout*; on failure/timeout we force the CPU backend via
-``jax.config.update("jax_platforms", "cpu")`` (which the plugin does honor)
-and still print the contract line with a truthful ``platform`` field.  Any
-in-run failure re-execs once with ``BENCH_PLATFORM=cpu``; the last-resort
-path prints a contract line with ``value: 0`` and an ``error`` field.
+at all): the environment's TPU plugin tunnels to a remote chip; backend init
+can take *minutes* (the remote end recycles one client session at a time) and
+hangs indefinitely when the tunnel is down.  Probing in killed subprocesses
+makes this WORSE — every killed prober holds the remote session and wedges
+the tunnel for the next attempt (observed: three 120 s probe timeouts in a
+row while the chip was healthy).  So we attach exactly once, in-process, with
+a watchdog thread: if ``jax.devices()`` hasn't returned within
+``BENCH_INIT_TIMEOUT`` seconds the watchdog re-execs this script with
+``BENCH_PLATFORM=cpu`` (the config flag beats plugins that ignore the
+JAX_PLATFORMS env var) and the attach outcome rides along in
+``BENCH_PROBE_DIAG`` so the emitted JSON is self-explaining.  Any in-run
+failure re-execs once with ``BENCH_PLATFORM=cpu``; the last-resort path
+prints a contract line with ``value: 0`` and an ``error`` field.
 
-Environment knobs: BENCH_PLATFORM (cpu|default: skip the probe),
-BENCH_PROBE_TIMEOUT (s per attempt, default 180), BENCH_PROBE_RETRIES
-(default 3), BENCH_B (instances), BENCH_STEPS (events or windows per rep),
-BENCH_REPS, BENCH_NODES, BENCH_ENGINE (parallel|serial|both).
+Environment knobs: BENCH_PLATFORM (cpu|default: skip the attach watchdog),
+BENCH_INIT_TIMEOUT (s, default 600), BENCH_B (instances), BENCH_STEPS
+(events or windows per rep), BENCH_REPS, BENCH_NODES, BENCH_ENGINE
+(parallel|serial|both).
 """
 
 from __future__ import annotations
@@ -31,55 +36,99 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
+# XLA/LLVM recursion on this repo's largest programs can overflow the default
+# 8 MB C stack (wandering SIGSEGVs in compile/serialize); the main thread's
+# stack grows up to RLIMIT_STACK, so raise the soft limit before any compile.
+try:
+    import resource
 
-def _decide_platform() -> tuple[str, dict]:
-    """Probe the default backend in a subprocess (the TPU plugin can hang
-    in-process init indefinitely when its tunnel is down).  The probe is
-    retried: a single-chip tunnel refuses a second holder, so a transient
-    failure (another process releasing the chip) must not demote a whole
-    graded run to CPU.  Returns (platform, probe_diagnostics)."""
-    diag = {"attempts": [], "forced": None}
+    _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    _want = 512 * 1024 * 1024
+    if _soft != resource.RLIM_INFINITY and _soft < _want:
+        resource.setrlimit(resource.RLIMIT_STACK, (
+            _want if _hard == resource.RLIM_INFINITY else min(_want, _hard),
+            _hard))
+except (ImportError, ValueError, OSError):
+    pass
+
+
+def _cpu_reexec(diag: dict):
+    """Replace this process with a CPU-pinned rerun, carrying diagnostics."""
+    env = dict(os.environ, BENCH_PLATFORM="cpu",
+               BENCH_PROBE_DIAG=json.dumps(diag))
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _tunnel_listening() -> bool:
+    """The TPU plugin reaches its pool service through a local relay
+    (AXON_POOL_SVC_OVERRIDE=127.0.0.1).  If nothing listens there the plugin
+    spins in a connect-retry loop forever — detect that in milliseconds
+    instead of burning the attach watchdog."""
+    import socket
+
+    for port in (8082, 8083, 8087):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=5.0):
+                return True
+        except OSError:
+            continue
+    return False
+
+
+def _attach_backend() -> tuple[str, dict]:
+    """One in-process backend attach, guarded by a watchdog.  Returns
+    (platform, diagnostics); on watchdog timeout this process is replaced by
+    a BENCH_PLATFORM=cpu rerun and never returns."""
+    diag = {"mode": "in-process", "forced": None, "init_seconds": None,
+            "timeout_s": None, "error": None}
+    prior = os.environ.get("BENCH_PROBE_DIAG")
+    if prior:
+        try:
+            diag = json.loads(prior)
+        except ValueError:
+            pass
     forced = os.environ.get("BENCH_PLATFORM")
     if forced:
         diag["forced"] = forced
         return forced, diag
-    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
-    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
-    for attempt in range(retries):
-        t0 = time.perf_counter()
-        rec = {"seconds": None, "rc": None, "error": None, "platform": None}
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
-                capture_output=True, text=True, timeout=timeout)
-            rec["rc"] = r.returncode
-            for line in (r.stdout or "").splitlines():
-                if line.startswith("PLATFORM="):
-                    rec["platform"] = line[len("PLATFORM="):].strip() or "cpu"
-            if rec["platform"] is None:
-                rec["error"] = (r.stderr or "")[-300:]
-        except Exception as e:  # noqa: BLE001 - timeout or spawn failure
-            rec["error"] = f"{type(e).__name__}: {e}"[:300]
-        rec["seconds"] = round(time.perf_counter() - t0, 1)
-        diag["attempts"].append(rec)
-        if rec["platform"] is not None:
-            return rec["platform"], diag
-        if attempt < retries - 1:
-            time.sleep(min(10.0 * (attempt + 1), 30.0))
-    return "cpu", diag
+    if os.environ.get("PALLAS_AXON_POOL_IPS") and not _tunnel_listening():
+        diag["error"] = "tpu tunnel relay not listening (dead tunnel)"
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu", diag
+    timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "600"))
+    diag["timeout_s"] = timeout
 
+    def fallback():
+        diag["error"] = f"backend init exceeded {timeout:.0f}s watchdog"
+        _cpu_reexec(diag)
 
-_PLATFORM, _PROBE_DIAG = _decide_platform()
+    dog = threading.Timer(timeout, fallback)
+    dog.daemon = True
+    dog.start()
+    t0 = time.perf_counter()
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # noqa: BLE001 - plugin init failure
+        dog.cancel()
+        diag["error"] = f"{type(e).__name__}: {e}"[:300]
+        diag["init_seconds"] = round(time.perf_counter() - t0, 1)
+        _cpu_reexec(diag)
+    dog.cancel()
+    diag["init_seconds"] = round(time.perf_counter() - t0, 1)
+    return platform, diag
+
 
 import jax  # noqa: E402
 
-if _PLATFORM == "cpu":
+if os.environ.get("BENCH_PLATFORM") == "cpu":
     # Must land before any backend init; the config flag beats plugins that
     # ignore the JAX_PLATFORMS env var.
     jax.config.update("jax_platforms", "cpu")
+
+_PLATFORM, _PROBE_DIAG = _attach_backend()
 
 os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
@@ -265,7 +314,10 @@ def main():
             # Retry once on the always-available backend.
             print(f"bench: {_PLATFORM} run failed ({type(e).__name__}); "
                   "re-running on cpu", file=sys.stderr)
-            env = dict(os.environ, BENCH_PLATFORM="cpu")
+            _PROBE_DIAG["error"] = f"{_PLATFORM} run failed: " \
+                f"{type(e).__name__}: {e}"[:300]
+            env = dict(os.environ, BENCH_PLATFORM="cpu",
+                       BENCH_PROBE_DIAG=json.dumps(_PROBE_DIAG))
             r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                env=env)
             sys.exit(r.returncode)

@@ -17,7 +17,10 @@ from flax import struct
 
 from . import pacemaker as pm_ops
 from . import store as store_ops
-from .types import NEVER, Context, NodeExtra, Pacemaker, SimParams, Store, sat_add
+from .types import (
+    NEVER, Context, NodeExtra, Pacemaker, SimParams, Store, pack_payload,
+    sat_add,
+)
 
 I32 = jnp.int32
 
@@ -29,11 +32,16 @@ def _i32(x):
 @struct.dataclass
 class NodeUpdateActions:
     """NodeUpdateActions (/root/reference/bft-lib/src/interfaces.rs:12-21):
-    ``should_send``/``should_broadcast`` merged into one receiver mask."""
+    ``should_send``/``should_broadcast`` merged into one receiver mask, plus
+    the cross-epoch handoff capture (old-epoch response pack built at an
+    epoch switch; empty [0] when SimParams.epoch_handoff is off)."""
 
     next_sched: jnp.ndarray    # NodeTime
     send_mask: jnp.ndarray     # [N] bool — receivers of our notification
     should_query_all: jnp.ndarray
+    ho_switched: jnp.ndarray   # bool: this update crossed an epoch boundary
+    ho_epoch: jnp.ndarray      # epoch the pack belongs to
+    ho_pack: jnp.ndarray       # [F] packed old-epoch response (or [0])
 
 
 def update_node(
@@ -108,7 +116,8 @@ def update_node(
     next_sched = jnp.where(qc_created, _i32(clock), pa.next_sched)
 
     # --- Deliver commits / switch epochs (node.rs:284-285, 308-352).
-    s, nx, ctx = process_commits(p, s, nx, ctx, weights)
+    s, nx, ctx, ho_switched, ho_epoch, ho_pack = process_commits(
+        p, s, nx, ctx, weights, author)
 
     # --- Commit tracker (node.rs:286-297, 363-397).
     nx, tr_query_all, tr_next = update_tracker(p, nx, s, clock)
@@ -119,15 +128,23 @@ def update_node(
     )
     send_mask = send_mask | jnp.where(broadcast, jnp.arange(n) != author, False)
     actions = NodeUpdateActions(
-        next_sched=next_sched, send_mask=send_mask, should_query_all=query_all
+        next_sched=next_sched, send_mask=send_mask, should_query_all=query_all,
+        ho_switched=ho_switched, ho_epoch=ho_epoch, ho_pack=ho_pack,
     )
     return s, pm, nx, ctx, actions
 
 
-def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights):
+def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights,
+                    author=0):
     """node.rs:313-351: deliver newly committed states to the context in
     ascending round order; on an epoch boundary, rebuild the record store for
-    the new epoch and stop delivering."""
+    the new epoch and stop delivering.
+
+    Returns (store, nx, ctx, ho_switched, ho_epoch, ho_pack): the ho_* values
+    are the cross-epoch handoff capture — the response payload of the
+    POST-update, PRE-switch store (the reference keeps whole previous-epoch
+    stores, node.rs record_store_at; this keeps one bounded pack), packed, or
+    a [0] placeholder when SimParams.epoch_handoff is off."""
     keep, rounds, depths, tags = store_ops.committed_states_after(p, s, nx.tracker_hcr)
     H_ = p.commit_log
 
@@ -169,6 +186,18 @@ def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         commit_count=cc, last_depth=lc_d, last_tag=lc_t, skipped_commits=sk,
         log_round=lr, log_depth=ld, log_tag=lt,
     )
+    # Cross-epoch handoff capture: the old store's full response pack (chain
+    # K-tail + highest CC), built before the switch discards it.
+    old_epoch = s.epoch_id
+    if p.epoch_handoff:
+        from . import data_sync
+
+        notif_old = data_sync.create_notification(p, s, author)
+        resp_old = data_sync.handle_request(p, s, author, notif_old,
+                                            notif=notif_old)
+        ho_pack = pack_payload(resp_old)
+    else:
+        ho_pack = jnp.zeros((0,), I32)
     # Epoch switch (node.rs:330-348): fresh record store anchored at the
     # committed state; reset voting constraints.
     s_new = new_epoch_store(p, s, sw_e, sw_d, sw_t)
@@ -177,7 +206,7 @@ def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         latest_voted_round=jnp.where(sw, 0, nx.latest_voted_round),
         locked_round=jnp.where(sw, 0, nx.locked_round),
     )
-    return s, nx, ctx
+    return s, nx, ctx, sw, old_epoch, ho_pack
 
 
 def new_epoch_store(p: SimParams, s: Store, epoch, state_depth, state_tag) -> Store:

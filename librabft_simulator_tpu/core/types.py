@@ -84,7 +84,13 @@ class SimParams:
     gamma: float = 2.0
     lam: float = 0.5          # lambda; fixed-point applied as (lam_fp * d) >> 16
     commit_chain: int = 3     # 3 = LibraBFTv2 3-chain; 2 = HotStuff-style 2-chain
+    epoch_handoff: bool = True  # serve one-epoch-behind requesters the
+                                # previous epoch's K-tail (data_sync.rs:82-92,
+                                # node.rs record_store_at); off = laggards jump
     # Network.
+    shuffle_receivers: bool = False  # seeded per-event receiver permutation
+                                     # (simulator.rs:343 fuzzing semantics);
+                                     # parity trio only (serial/oracle/C++)
     inbox_cap: int = 0        # parallel engine per-receiver slots (0 = auto)
     delay_kind: str = "lognormal"
     delay_mean: float = 10.0
@@ -563,6 +569,12 @@ class SimState:
     node: NodeExtra       # fields [N]
     ctx: Context          # fields [N, ...]
     queue: Queue
+    # Cross-epoch handoff: the response payload captured at this node's last
+    # epoch switch, built from the pre-switch store (old epoch), served to
+    # requesters still in that epoch (data_sync.rs:82-92 semantics).  Absent
+    # when SimParams.epoch_handoff is False (zero-width arrays).
+    ho_pay: Array         # [N, F] packed Payload rows (or [N, 0])
+    ho_epoch: Array       # [N] epoch the pack belongs to; -1 = none
     timer_time: Array     # [N] global time of each node's (single) pending timer
     timer_stamp: Array    # [N]
     startup: Array        # [N] startup_time (global)

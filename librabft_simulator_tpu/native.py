@@ -41,7 +41,7 @@ def _load() -> ctypes.CDLL:
         lib = ctypes.CDLL(build())
         lib.bft_run.restype = ctypes.c_int
         lib.bft_run.argtypes = (
-            [ctypes.c_int] * 11
+            [ctypes.c_int] * 13
             + [ctypes.c_uint32, ctypes.c_uint32, ctypes.c_longlong]
             + [
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),   # delay
@@ -120,6 +120,7 @@ def run(p: SimParams, seed: int, weights=None, byz_equivocate=None,
         p.n_nodes, p.window, p.queue_cap, p.chain_k, p.commit_log,
         p.commands_per_epoch, p.target_commit_interval, p.lam_fp,
         p.commit_chain, p.max_clock, p.dur_table_size,
+        int(p.shuffle_receivers), int(p.epoch_handoff),
         ctypes.c_uint32(p.drop_u32), ctypes.c_uint32(seed & 0xFFFFFFFF),
         ctypes.c_longlong(max_events),
         delay, dur, w, eq, silent, glob, node, log,

@@ -127,6 +127,9 @@ class NodeUpdateActions:
     next_sched: int
     send_mask: List[bool]
     should_query_all: bool
+    ho_switched: bool = False
+    ho_epoch: int = -1
+    ho_pack: object = None  # Payload | None: old-epoch response pack
 
 
 def update_node(p, s: E.Store, pm: Pacemaker, nx: NodeExtra, cx: Context,
@@ -162,7 +165,8 @@ def update_node(p, s: E.Store, pm: Pacemaker, nx: NodeExtra, cx: Context,
     broadcast = pa.should_broadcast or qc_created
     next_sched = clock if qc_created else pa.next_sched
 
-    process_commits(p, s, nx, cx, weights)
+    ho_switched, ho_epoch, ho_pack = process_commits(p, s, nx, cx, weights,
+                                                     author)
 
     nx2, tr_query_all, tr_next = update_tracker(p, nx, s, clock)
     query_all = pa.should_query_all or tr_query_all
@@ -171,10 +175,15 @@ def update_node(p, s: E.Store, pm: Pacemaker, nx: NodeExtra, cx: Context,
         nx.latest_query_all = clock
     if broadcast:
         send_mask = [m or (i != author) for i, m in enumerate(send_mask)]
-    return NodeUpdateActions(next_sched, send_mask, query_all)
+    return NodeUpdateActions(next_sched, send_mask, query_all,
+                             ho_switched, ho_epoch, ho_pack)
 
 
-def process_commits(p, s: E.Store, nx: NodeExtra, cx: Context, weights):
+def process_commits(p, s: E.Store, nx: NodeExtra, cx: Context, weights,
+                    author=0):
+    """Returns (ho_switched, ho_epoch, ho_pack): the cross-epoch handoff
+    capture — the old store's response pack built post-update, pre-switch
+    (mirrors core/node.py process_commits)."""
     commits = s.committed_states_after(nx.tracker_hcr)
     H = p.commit_log
     switch = False
@@ -195,6 +204,10 @@ def process_commits(p, s: E.Store, nx: NodeExtra, cx: Context, weights):
         if new_epoch > s.epoch_id:
             switch = True
             sw_e, sw_d, sw_t = new_epoch, d, t
+    ho_epoch = s.epoch_id
+    ho_pack = None
+    if p.epoch_handoff and switch:
+        ho_pack = handle_request(p, s, author, None)
     if switch:
         fresh = E.Store(p)
         fresh.epoch_id = sw_e
@@ -204,6 +217,7 @@ def process_commits(p, s: E.Store, nx: NodeExtra, cx: Context, weights):
         s.__dict__.update(fresh.__dict__)
         nx.latest_voted_round = 0
         nx.locked_round = 0
+    return switch, ho_epoch, ho_pack
 
 
 def update_tracker(p, nx: NodeExtra, s: E.Store, clock):
@@ -418,6 +432,10 @@ class OracleSim:
         ]
         self.timer_time = list(self.startup)
         self.timer_stamp = list(range(n))
+        # Cross-epoch handoff packs (mirrors SimState.ho_pay / ho_epoch).
+        self.ho_pay: List = [None] * n
+        self.ho_epoch = [-1] * n
+        self.n_handoff_served = 0  # oracle-only diagnostic
         self.clock = 0
         self.stamp_ctr = n
         self.halted = False
@@ -543,6 +561,14 @@ class OracleSim:
             for i in range(n)
         ]
 
+        if p.shuffle_receivers:
+            # Mirrors sim/simulator.py: stable sort of per-receiver hash keys.
+            base = E.rng_u32(self.seed, self.stamp_ctr & E.M32)
+            keys = [E.mix32(base, i + 1) for i in range(n)]
+            recv_order = sorted(range(n), key=lambda i: (keys[i], i))
+        else:
+            recv_order = list(range(n))
+
         # Payload bank (mirrors simulator.py: computed on the post-update store).
         notif = create_notification(p, s, a)
         if self.byz_forge_qc[a]:
@@ -554,12 +580,25 @@ class OracleSim:
             # The tensor path builds the response from the (forged) notif.
             response.hqc = copy.deepcopy(notif.hqc)
 
-        want = [cand0_want] + send_mask + query_mask
+        # Cross-epoch handoff (mirrors sim/simulator.py): capture the pack
+        # update_node built from the post-update, pre-switch store; serve it
+        # to requesters still in that epoch.
+        if p.epoch_handoff:
+            if do_update and actions.ho_switched:
+                self.ho_pay[a] = copy.deepcopy(actions.ho_pack)
+                self.ho_epoch[a] = actions.ho_epoch
+            if (is_request and pay_in.epoch == self.ho_epoch[a]
+                    and pay_in.epoch < s.epoch_id):
+                response = copy.deepcopy(self.ho_pay[a])
+                self.n_handoff_served += 1
+
+        want = ([cand0_want] + [send_mask[i] for i in recv_order]
+                + [query_mask[i] for i in recv_order])
         kinds = [cand0_kind] + [KIND_NOTIFY] * n + [KIND_REQUEST] * n
-        recvs = [cand0_recv] + list(range(n)) + list(range(n))
+        recvs = [cand0_recv] + recv_order + recv_order
         upper = [(i * 2 >= n) for i in range(n)]
         pays = [response if want_response else request]
-        for i in range(n):
+        for i in recv_order:
             pays.append(notif_b if (self.byz_equivocate[a] and upper[i]) else notif)
         pays += [request] * n
 

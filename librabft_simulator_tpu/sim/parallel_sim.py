@@ -80,6 +80,8 @@ class PSimState:
     byz_forge_qc: jnp.ndarray
     max_clock: jnp.ndarray   # i32 horizon (dynamic; see SimParams.structural)
     drop_u32: jnp.ndarray    # u32 drop threshold (dynamic)
+    ho_pay: jnp.ndarray      # [N, F] cross-epoch handoff packs ([N, 0] if off)
+    ho_epoch: jnp.ndarray    # [N]; -1 = none
     in_valid: jnp.ndarray    # [N, IC] bool
     in_time: jnp.ndarray     # [N, IC]
     in_kind: jnp.ndarray     # [N, IC]
@@ -150,6 +152,8 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         byz_forge_qc=jnp.asarray(byz_forge_qc, jnp.bool_),
         max_clock=_i32(p.max_clock),
         drop_u32=jnp.uint32(p.drop_u32),
+        ho_pay=jnp.zeros((n, F if p.epoch_handoff else 0), I32),
+        ho_epoch=jnp.full((n,), -1, I32),
         clock=_i32(0),
         node_ctr=jnp.ones((n,), I32),
         halted=jnp.bool_(False),
@@ -225,7 +229,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     do_update = active & (is_timer | is_notify | is_response)
     local_clock = t_ev - st.startup  # each node handles its own event time
 
-    def per_node(a, s_a, pm_a, nx_a, cx_a, pay_row, lclk):
+    def per_node(a, s_a, pm_a, nx_a, cx_a, pay_row, lclk, ho_row, ho_ep):
         pay_in = unpack_payload(p, pay_row)
         s_n, should_sync = data_sync.handle_notification(p, s_a, st.weights, pay_in)
         s_r, nx_r, cx_r = data_sync.handle_response(p, s_a, nx_a, cx_a,
@@ -245,17 +249,32 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
                                _forged_qc_payload(p, s_f, a, notif), notif)
         request = data_sync.create_request(p, s_f)
         response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
+        resp_packed = pack_payload(response)
+        if p.epoch_handoff:
+            # Cross-epoch handoff (mirrors sim/simulator.py): capture the
+            # pack update_node built from the post-update, pre-switch store;
+            # serve it to requesters still in that epoch.
+            switched = do_update[a] & actions.ho_switched
+            ho_row = jnp.where(switched, actions.ho_pack, ho_row)
+            ho_ep = jnp.where(switched, actions.ho_epoch, ho_ep)
+            serve_ho = (is_request[a] & (pay_in.epoch == ho_ep)
+                        & (pay_in.epoch < s_f.epoch_id))
+            resp_row = jnp.where(serve_ho, ho_row, resp_packed)
+        else:
+            resp_row = resp_packed
         notif_p = pack_payload(notif)
         bank = jnp.stack([
             notif_p,
             pack_payload(_equivocate(p, notif)),
             pack_payload(request),
-            pack_payload(response),
+            resp_row,
         ])
-        return s_f, pm_f, nx_f, cx_f, actions, should_sync, bank
+        return s_f, pm_f, nx_f, cx_f, actions, should_sync, bank, ho_row, ho_ep
 
-    s_f, pm_f, nx_f, cx_f, actions, should_sync, banks = jax.vmap(per_node)(
-        jnp.arange(n), st.store, st.pm, st.node, st.ctx, pay_rows, local_clock)
+    (s_f, pm_f, nx_f, cx_f, actions, should_sync, banks, ho_pay,
+     ho_epoch) = jax.vmap(per_node)(
+        jnp.arange(n), st.store, st.pm, st.node, st.ctx, pay_rows, local_clock,
+        st.ho_pay, st.ho_epoch)
 
     # ---- Outgoing candidates: [N senders, 2n+1 candidates].
     silent = st.byz_silent
@@ -352,6 +371,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
 
     return st.replace(
         store=s_f, pm=pm_f, node=nx_f, ctx=cx_f,
+        ho_pay=ho_pay, ho_epoch=ho_epoch,
         in_valid=in_valid2, in_time=in_time2, in_kind=in_kind2,
         in_stamp=in_stamp2, in_sender=in_sender2, in_pay=in_pay2,
         timer_time=timer_time,

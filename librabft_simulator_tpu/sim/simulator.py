@@ -13,7 +13,9 @@ reference's ignore_scheduled_updates_until cancellation, simulator.rs:311-323).
 
 Known, self-consistent divergences from the reference (the oracle replays the
 same semantics, so parity holds):
-  * receivers are enumerated in index order, not shuffled (simulator.rs:343);
+  * receivers are enumerated in index order by default; set
+    ``SimParams.shuffle_receivers`` for the reference's per-broadcast shuffle
+    semantics (simulator.rs:343) via a seeded, oracle-replayable permutation;
   * notification/request payloads snapshot the post-update node state;
   * message drops and queue overflow (counted) replace unbounded heaps.
 """
@@ -82,12 +84,16 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         byz_silent = jnp.zeros((n,), jnp.bool_)
     if byz_forge_qc is None:
         byz_forge_qc = jnp.zeros((n,), jnp.bool_)
+    from ..core.types import payload_width
+
     return SimState(
         store=Store.initial(p, (n,)),
         pm=Pacemaker.initial((n,)),
         node=NodeExtra.initial((n,)),
         ctx=Context.initial(p, (n,)),
         queue=Queue.initial(p),
+        ho_pay=jnp.zeros((n, payload_width(p) if p.epoch_handoff else 0), I32),
+        ho_epoch=jnp.full((n,), -1, I32),
         timer_time=startup.astype(I32),
         timer_stamp=jnp.arange(n, dtype=I32),
         startup=startup.astype(I32),
@@ -225,10 +231,29 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     notif_b = _equivocated_payload(p, s_f, a, notif)
     request = data_sync.create_request(p, s_f)
     response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
+    resp_packed = pack_payload(response)
+    if p.epoch_handoff:
+        # Cross-epoch handoff (reference keeps previous epochs' stores:
+        # node.rs record_store_at, data_sync.rs:82-92; here one bounded
+        # packed response per node): update_node captured the old-epoch pack
+        # at the switch (post-update, pre-switch store — the commit-enabling
+        # QC is often minted in the same update); serve it to a requester
+        # still in that epoch.
+        switched = do_update & actions.ho_switched
+        ho_row = jnp.where(switched, actions.ho_pack, st.ho_pay[a])
+        ho_epoch_v = jnp.where(switched, actions.ho_epoch, st.ho_epoch[a])
+        ho_pay = st.ho_pay.at[a].set(ho_row)
+        ho_epoch = st.ho_epoch.at[a].set(ho_epoch_v)
+        serve_ho = (is_request & (pay_in.epoch == ho_epoch_v)
+                    & (pay_in.epoch < s_f.epoch_id))
+        resp_row = jnp.where(serve_ho, ho_row, resp_packed)
+    else:
+        ho_pay, ho_epoch = st.ho_pay, st.ho_epoch
+        resp_row = resp_packed
     # [4, F] packed bank: one row per candidate payload kind.
     payload_bank = jnp.stack([
         pack_payload(notif), pack_payload(notif_b),
-        pack_payload(request), pack_payload(response),
+        pack_payload(request), resp_row,
     ])
 
     silent = st.byz_silent[a]
@@ -248,15 +273,29 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     notif_sel = jnp.where(st.byz_equivocate[a] & upper, _i32(1), _i32(0))
     query_mask = jnp.where(actions.should_query_all & do_update & ~silent, others, False)
 
-    want = jnp.concatenate([cand0_want[None], send_mask, query_mask])
+    if p.shuffle_receivers:
+        # Seeded per-event receiver permutation (the reference shuffles
+        # delivery order per broadcast, simulator.rs:343): receivers keep
+        # their payload/mask but take the stamp — hence the delay draw — of
+        # their permuted position.  Keyed off (seed, stamp_ctr) so the oracle
+        # and C++ engine replay it exactly (stable argsort, ties by index).
+        base = H.rng_u32(st.seed, jnp.asarray(st.stamp_ctr).astype(jnp.uint32))
+        keys = jax.vmap(lambda i: H.mix32(base, i + jnp.uint32(1)))(
+            jnp.arange(n, dtype=jnp.uint32))
+        recv_order = jnp.argsort(keys, stable=True).astype(I32)
+    else:
+        recv_order = jnp.arange(n, dtype=I32)
+
+    want = jnp.concatenate([cand0_want[None], send_mask[recv_order],
+                            query_mask[recv_order]])
     kinds = jnp.concatenate([
         cand0_kind[None],
         jnp.full((n,), KIND_NOTIFY, I32),
         jnp.full((n,), KIND_REQUEST, I32),
     ])
-    recvs = jnp.concatenate([cand0_recv[None], jnp.arange(n, dtype=I32),
-                             jnp.arange(n, dtype=I32)])
-    pay_sel = jnp.concatenate([cand0_pay[None], notif_sel, jnp.full((n,), 2, I32)])
+    recvs = jnp.concatenate([cand0_recv[None], recv_order, recv_order])
+    pay_sel = jnp.concatenate([cand0_pay[None], notif_sel[recv_order],
+                               jnp.full((n,), 2, I32)])
 
     # Stamps: candidate 0, then one for the timer reschedule, then the rest.
     pos_in_want = jnp.cumsum(want) - 1
@@ -330,6 +369,8 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         node=_node_update(st.node, a, nx_f),
         ctx=_node_update(st.ctx, a, cx_f),
         queue=queue,
+        ho_pay=ho_pay,
+        ho_epoch=ho_epoch,
         timer_time=timer_time,
         timer_stamp=timer_stamp,
         clock=jnp.where(live, clock, st.clock),

@@ -62,6 +62,8 @@ struct Params {
   int n_nodes, window, queue_cap, chain_k, commit_log;
   int commands_per_epoch, target_commit_interval, delta;
   int lam_fp, commit_chain, max_clock, dur_table_size;
+  int shuffle_receivers = 0;
+  int epoch_handoff = 1;
   u32 drop_u32;
   // tables appended by caller
 };
@@ -626,6 +628,10 @@ struct NodeActions {
   int next_sched = NEVER;
   std::vector<uint8_t> send_mask;
   bool should_query_all = false;
+  // Cross-epoch handoff capture (mirrors core/node.py NodeUpdateActions).
+  bool ho_switched = false;
+  int ho_epoch_old = -1;
+  Payload ho_pack;
 };
 
 struct Engine {
@@ -643,6 +649,9 @@ struct Engine {
     Payload pay;
   };
   std::vector<Msg> queue;
+  // Cross-epoch handoff packs (mirrors SimState.ho_pay / ho_epoch).
+  std::vector<Payload> ho_pay;
+  std::vector<int> ho_epoch;
   std::vector<int> startup, timer_time, timer_stamp;
   int clock = 0, stamp_ctr = 0;
   bool halted = false;
@@ -664,6 +673,8 @@ struct Engine {
       ctxs.emplace_back(p.commit_log);
     }
     queue.assign(p.queue_cap, Msg{false, 0, 0, 0, 0, 0, Payload(n, p.chain_k)});
+    ho_pay.assign(n, Payload(n, p.chain_k));
+    ho_epoch.assign(n, -1);
     for (int c = 0; c < n; c++) {
       int d = delay_table[rng_u32(seed, (u32)c) >> (32 - TABLE_BITS)] + 1;
       startup.push_back(d);
@@ -724,7 +735,8 @@ struct Engine {
     return a;
   }
 
-  void process_commits(Store& s, NodeExtra& nx, Context& cx) {
+  void process_commits(Store& s, NodeExtra& nx, Context& cx, int author,
+                       NodeActions& out) {
     auto commits = s.committed_states_after(nx.tracker_hcr);
     int H = p.commit_log;
     bool sw = false;
@@ -749,6 +761,11 @@ struct Engine {
       }
     }
     if (sw) {
+      // Cross-epoch handoff capture: the old store's response pack, built
+      // post-update pre-switch (mirrors core/node.py process_commits).
+      out.ho_switched = true;
+      out.ho_epoch_old = s.epoch_id;
+      if (p.epoch_handoff) out.ho_pack = handle_request(s, author, Payload());
       s.reset();
       s.epoch_id = sw_e;
       s.initial_tag = epoch_initial_tag((u32)sw_e);
@@ -812,7 +829,7 @@ struct Engine {
     bool qc_created = s.check_new_qc(weights, author);
     bool broadcast = pa.should_broadcast || qc_created;
     out.next_sched = qc_created ? clk : pa.next_sched;
-    process_commits(s, nx, cx);
+    process_commits(s, nx, cx, author, out);
     bool tr_query;
     int tr_next;
     update_tracker(nx, s, clk, tr_query, tr_next);
@@ -1066,6 +1083,20 @@ struct Engine {
     actions.send_mask.assign(n, 0);
     if (do_update) actions = update_node(s, pm, nx, cx, a, local_clock);
 
+    Payload response = handle_request(s, a, pay_in);
+    // Cross-epoch handoff (mirrors sim/simulator.py): capture the pack
+    // update_node built from the post-update, pre-switch store; serve it to
+    // requesters still in that epoch.
+    if (p.epoch_handoff) {
+      if (do_update && actions.ho_switched) {
+        ho_pay[a] = actions.ho_pack;
+        ho_epoch[a] = actions.ho_epoch_old;
+      }
+      if (is_request && pay_in.epoch == ho_epoch[a] &&
+          pay_in.epoch < s.epoch_id)
+        response = ho_pay[a];
+    }
+
     bool silent = byz_silent[a];
     bool want_sync_req = is_notify && should_sync && !silent;
     bool want_response = is_request && !silent;
@@ -1076,7 +1107,6 @@ struct Engine {
     Payload notif = create_notification(s, a);
     Payload notif_b = equivocated(notif);
     Payload request = create_request(s);
-    Payload response = handle_request(s, a, pay_in);
 
     int ncand = 2 * n + 1;
     std::vector<uint8_t> want(ncand, 0);
@@ -1085,15 +1115,27 @@ struct Engine {
     kinds[0] = cand0_kind;
     recvs[0] = cand0_recv;
     paysel[0] = want_response ? 3 : 2;
+    // Seeded receiver permutation (mirrors sim/simulator.py: stable sort of
+    // per-receiver hash keys off (seed, pre-update stamp_ctr)).
+    std::vector<int> recv_order(n);
+    for (int i = 0; i < n; i++) recv_order[i] = i;
+    if (p.shuffle_receivers) {
+      u32 base = rng_u32(seed, (u32)stamp_ctr);
+      std::vector<u32> keys(n);
+      for (int i = 0; i < n; i++) keys[i] = mix32(base, (u32)(i + 1));
+      std::stable_sort(recv_order.begin(), recv_order.end(),
+                       [&](int x, int y) { return keys[x] < keys[y]; });
+    }
     for (int i = 0; i < n; i++) {
-      want[1 + i] = actions.send_mask[i] && i != a && do_update && !silent;
+      int r = recv_order[i];
+      want[1 + i] = actions.send_mask[r] && r != a && do_update && !silent;
       kinds[1 + i] = KIND_NOTIFY;
-      recvs[1 + i] = i;
-      paysel[1 + i] = (byz_eq[a] && (i * 2 >= n)) ? 1 : 0;
+      recvs[1 + i] = r;
+      paysel[1 + i] = (byz_eq[a] && (r * 2 >= n)) ? 1 : 0;
       want[1 + n + i] =
-          actions.should_query_all && do_update && !silent && i != a;
+          actions.should_query_all && do_update && !silent && r != a;
       kinds[1 + n + i] = KIND_REQUEST;
-      recvs[1 + n + i] = i;
+      recvs[1 + n + i] = r;
       paysel[1 + n + i] = 2;
     }
     int timer_gap = do_update ? 1 : 0;
@@ -1170,8 +1212,9 @@ int bft_run(
     // params
     int n_nodes, int window, int queue_cap, int chain_k, int commit_log,
     int commands_per_epoch, int target_commit_interval, int lam_fp,
-    int commit_chain, int max_clock, int dur_table_size, u32 drop_u32,
-    u32 seed, i64 max_events,
+    int commit_chain, int max_clock, int dur_table_size,
+    int shuffle_receivers, int epoch_handoff, u32 drop_u32, u32 seed,
+    i64 max_events,
     // tables / masks
     const int* delay_table, const int* dur_table, const int* weights,
     const uint8_t* byz_eq, const uint8_t* byz_silent,
@@ -1187,6 +1230,8 @@ int bft_run(
   p.target_commit_interval = target_commit_interval;
   p.delta = 0; p.lam_fp = lam_fp; p.commit_chain = commit_chain;
   p.max_clock = max_clock; p.dur_table_size = dur_table_size;
+  p.shuffle_receivers = shuffle_receivers;
+  p.epoch_handoff = epoch_handoff;
   p.drop_u32 = drop_u32;
   Engine e(p, seed, delay_table, dur_table, weights, byz_eq, byz_silent);
   e.run(max_events);

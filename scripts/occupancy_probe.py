@@ -60,15 +60,27 @@ def ablate(name):
         ds.handle_request = lambda p, s, a, req, notif=None: (
             notif if notif is not None else ds.create_notification(p, s, a))
     elif name == "commits":
-        node_ops.process_commits = lambda p, s, nx, ctx, w: (s, nx, ctx)
+        import jax.numpy as jnp
+        from librabft_simulator_tpu.core.types import payload_width
+
+        def _stub_commits(p, s, nx, ctx, w, author=0):
+            F = payload_width(p) if p.epoch_handoff else 0
+            return (s, nx, ctx, jnp.bool_(False), s.epoch_id,
+                    jnp.zeros((F,), jnp.int32))
+        node_ops.process_commits = _stub_commits
     elif name == "update":
         def _stub_update(p, s, pm, nx, cx, w, a, clock, dur):
             import jax.numpy as jnp
+            from librabft_simulator_tpu.core.types import payload_width
             n = p.n_nodes
+            F = payload_width(p) if p.epoch_handoff else 0
             return s, pm, nx, cx, node_ops.NodeUpdateActions(
                 next_sched=jnp.asarray(clock + 10, jnp.int32),
                 send_mask=jnp.zeros((n,), jnp.bool_),
-                should_query_all=jnp.bool_(False))
+                should_query_all=jnp.bool_(False),
+                ho_switched=jnp.bool_(False),
+                ho_epoch=s.epoch_id,
+                ho_pack=jnp.zeros((F,), jnp.int32))
         node_ops.update_node = _stub_update
     elif name:
         raise ValueError(name)

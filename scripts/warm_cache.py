@@ -1,0 +1,72 @@
+"""Pre-warm the XLA persistent compile cache for the test suite.
+
+The suite (tests/conftest.py) runs the cache READ-ONLY: cache writes
+(``executable.serialize()``) segfault jaxlib in long-running processes that
+have accumulated many large compiles.  In a fresh process per shape the
+writes are reliable — so this script compiles each heavy (engine, shape)
+pair in its own subprocess, after which the suite runs from cache.
+
+Usage:  python scripts/warm_cache.py            # all shapes
+        python scripts/warm_cache.py --list     # show shapes
+"""
+import os
+import subprocess
+import sys
+
+SHAPES = [
+    # (engine, SimParams kwargs) — the structural shapes the suite compiles.
+    ("serial", {}),                                       # defaults (parity)
+    ("serial", {"n_nodes": 4}),
+    ("serial", {"n_nodes": 4, "window": 8, "chain_k": 2, "commit_log": 16}),
+    ("serial", {"n_nodes": 3, "commands_per_epoch": 6}),  # epoch handoff
+    ("parallel", {"n_nodes": 4, "window": 8, "chain_k": 2, "commit_log": 16}),
+    ("parallel", {"n_nodes": 3, "commands_per_epoch": 6}),
+    ("parallel", {"n_nodes": 4}),
+]
+
+CHILD = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import sys, json
+import numpy as np
+sys.path.insert(0, %(root)r)
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import parallel_sim, simulator
+from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+
+engine_name, kw = json.loads(sys.argv[1])
+engine = parallel_sim if engine_name == "parallel" else simulator
+p = SimParams(max_clock=500, **kw)
+st = dedupe_buffers(engine.init_batch(p, np.arange(4, dtype=np.uint32)))
+run = engine.make_run_fn(p, 256)
+jax.block_until_ready(run(st))
+print("warmed", engine_name, kw)
+"""
+
+
+def main():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if "--list" in sys.argv:
+        for e, kw in SHAPES:
+            print(e, kw)
+        return
+    import json
+
+    for e, kw in SHAPES:
+        r = subprocess.run(
+            [sys.executable, "-c", CHILD % {"root": root},
+             json.dumps([e, kw])],
+            cwd=root)
+        print(f"[warm_cache] {e} {kw}: rc={r.returncode}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

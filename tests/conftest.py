@@ -1,5 +1,22 @@
 import os
 
+# XLA/LLVM recursion while compiling (or serializing) this repo's largest
+# scan programs overflows the default 8 MB C stack — observed as wandering
+# SIGSEGVs in backend_compile / executable.serialize().  The main thread's
+# stack grows on demand up to RLIMIT_STACK, so raising the soft limit early
+# is sufficient.
+import resource
+
+_soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+_want = 512 * 1024 * 1024
+if _soft != resource.RLIM_INFINITY and _soft < _want:
+    try:
+        resource.setrlimit(resource.RLIMIT_STACK, (
+            _want if _hard == resource.RLIM_INFINITY else min(_want, _hard),
+            _hard))
+    except (ValueError, OSError):
+        pass
+
 # Virtual 8-device CPU mesh for tests; must happen before any jax computation.
 # (The axon TPU plugin ignores the JAX_PLATFORMS env var, so we also set the
 # config flag explicitly.)
@@ -8,9 +25,36 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Every compiled XLA executable adds hundreds of memory mappings and JAX
+# keeps them all alive; a full-suite pytest process crosses the default
+# vm.max_map_count (65530) after ~75 tests, after which mmap failures
+# surface as SIGSEGV inside whatever touches a large executable next
+# (compile, serialize, or cache-read — observed as wandering segfaults
+# always at the same test count).  Raise the limit when we can (root
+# container); otherwise trim JAX's live-executable count per module below.
+try:
+    with open("/proc/sys/vm/max_map_count") as _f:
+        _map_count = int(_f.read())
+    if _map_count < 1048576:
+        with open("/proc/sys/vm/max_map_count", "w") as _f:
+            _f.write("1048576")
+    _MAPS_RAISED = True
+except OSError:
+    _MAPS_RAISED = False
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: repeat test runs skip XLA recompiles.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_runtest_teardown(item, nextitem):
+    """Fallback when vm.max_map_count could not be raised: drop live
+    executables between modules so mappings don't accumulate past the limit
+    (the persistent cache makes later reloads cheap)."""
+    if _MAPS_RAISED:
+        return
+    if nextitem is None or item.fspath != nextitem.fspath:
+        jax.clear_caches()

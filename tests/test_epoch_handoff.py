@@ -1,0 +1,93 @@
+"""Cross-epoch catch-up (SimParams.epoch_handoff).
+
+The reference keeps previous epochs' record stores and serves their records
+to laggards (/root/reference/librabft-v2/src/node.rs ``record_store_at``,
+``data_sync.rs:82-92``).  The windowed rebuild drops old stores at an epoch
+switch; without a handoff, a node reaching the boundary first can DEADLOCK
+the network: the new epoch can't reach quorum (peers are still in the old
+epoch and reject new-epoch records), and the old epoch can't finish (the
+switched node's store no longer holds the boundary chain; a state-sync jump
+is impossible while the new epoch has no QC to anchor on).
+
+The handoff: at the switch, capture the old store's full response pack (built
+post-update, pre-switch — the commit-enabling QC is often minted in the same
+update); serve it to any requester still in that epoch.  Laggards then commit
+through the boundary in order and switch on their own — no jump, no skipped
+commits for one-epoch laggards.
+"""
+
+import numpy as np
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.oracle.sim import OracleSim
+from librabft_simulator_tpu.sim import parallel_sim as P
+from librabft_simulator_tpu.sim.byzantine import check_safety
+from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+
+from test_native import assert_native_matches_oracle
+from test_parity import assert_parity
+
+import jax
+
+
+def boundary_params(**kw):
+    kw.setdefault("n_nodes", 3)
+    kw.setdefault("commands_per_epoch", 6)
+    kw.setdefault("max_clock", 12000)
+    kw.setdefault("drop_prob", 0.15)
+    return SimParams(**kw)
+
+
+def test_handoff_cures_boundary_deadlock():
+    """Seed 3 deadlocks at the first boundary without the handoff (one node
+    switches, the rest can never follow); with it the fleet keeps committing
+    across epochs."""
+    on = OracleSim(boundary_params(max_clock=60000), 3).run(max_events=2000000)
+    assert min(s.epoch_id for s in on.stores) >= 1
+    assert min(c.commit_count for c in on.ctxs) >= 10
+    assert on.n_handoff_served > 0
+
+    off = OracleSim(boundary_params(max_clock=60000, epoch_handoff=False),
+                    3).run(max_events=2000000)
+    assert max(c.commit_count for c in off.ctxs) <= 6  # stuck at the boundary
+
+
+def test_handoff_laggards_keep_full_history():
+    """One-epoch laggards served by the handoff commit the boundary depths in
+    order: no state-sync jumps, (almost) no skipped commits."""
+    o = OracleSim(boundary_params(), 3).run(max_events=500000)
+    assert min(s.epoch_id for s in o.stores) >= 1
+    assert sum(c.sync_jumps for c in o.ctxs) == 0
+    assert sum(c.skipped_commits for c in o.ctxs) == 0
+
+
+def test_handoff_parity_jax_vs_oracle():
+    st, orc = assert_parity(boundary_params(), 3)
+    assert orc.n_handoff_served > 0
+    assert min(int(x) for x in st.store.epoch_id) >= 1
+
+
+def test_handoff_parity_native_vs_oracle():
+    res, orc = assert_native_matches_oracle(boundary_params(), 3)
+    assert orc.n_handoff_served > 0
+
+
+def test_parallel_engine_crosses_epochs():
+    """The windowed parallel engine with the handoff also advances past the
+    boundary and stays safe."""
+    p = boundary_params(max_clock=30000, delay_kind="uniform", drop_prob=0.1,
+                        window=16, chain_k=4)
+    seeds = np.arange(8, dtype=np.uint32)
+    st = P.init_batch(p, seeds)
+    st = dedupe_buffers(st)
+    run = P.make_run_fn(p, 256)
+    # Sync-storm instances advance ~1 time unit per window, so the window
+    # budget must comfortably exceed max_clock/256 chunks (observed: ~255).
+    for _ in range(400):
+        st = run(st)
+        if bool(np.all(jax.device_get(st.halted))):
+            break
+    assert bool(np.all(jax.device_get(st.halted)))
+    ep = np.asarray(jax.device_get(st.store.epoch_id))
+    assert (ep.max(axis=1) >= 1).mean() > 0.5  # most instances cross
+    assert bool(np.all(check_safety(st)))

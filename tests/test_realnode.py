@@ -172,3 +172,61 @@ def test_three_real_nodes_commit(tmp_path):
                 await c.close()
 
     asyncio.run(go())
+
+
+# ---- Timer (bft-driver/src/tests/timer_tests.rs) ---------------------------
+
+
+def test_timer_schedule_fires_after_deadline():
+    """timer_tests.rs `schedule`: a 100 ms deadline resolves no earlier."""
+    from librabft_simulator_tpu.realnode.driver import Timer
+
+    async def go():
+        timer = Timer()
+        now_ms = lambda: time.monotonic() * 1000.0  # noqa: E731
+        t0 = time.monotonic()
+        timer.schedule(now_ms() + 100)
+        await timer.wait(now_ms)
+        assert time.monotonic() - t0 > 0.095
+
+    import time
+
+    asyncio.run(go())
+
+
+def test_timer_reschedule_overrides_deadline():
+    """The reference timer is resettable: re-arming to an earlier deadline
+    preempts the pending one (core.rs re-schedules on every update)."""
+    from librabft_simulator_tpu.realnode.driver import Timer
+    import time
+
+    async def go():
+        timer = Timer()
+        now_ms = lambda: time.monotonic() * 1000.0  # noqa: E731
+        t0 = time.monotonic()
+        timer.schedule(now_ms() + 5000)
+        waiter = asyncio.create_task(timer.wait(now_ms))
+        await asyncio.sleep(0.05)
+        timer.schedule(now_ms() + 50)  # pull the deadline in
+        await asyncio.wait_for(waiter, timeout=2.0)
+        elapsed = time.monotonic() - t0
+        assert 0.09 < elapsed < 2.0, elapsed
+
+    asyncio.run(go())
+
+
+def test_timer_wait_blocks_until_armed():
+    """wait() with no deadline parks until schedule() arms one."""
+    from librabft_simulator_tpu.realnode.driver import Timer
+    import time
+
+    async def go():
+        timer = Timer()
+        now_ms = lambda: time.monotonic() * 1000.0  # noqa: E731
+        waiter = asyncio.create_task(timer.wait(now_ms))
+        await asyncio.sleep(0.05)
+        assert not waiter.done()
+        timer.schedule(now_ms() + 10)
+        await asyncio.wait_for(waiter, timeout=2.0)
+
+    asyncio.run(go())
