@@ -57,6 +57,12 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
             getattr(pp, "name", None) or str(getattr(pp, "idx", pp)) for pp in path
         )
         if key not in data:
+            # Forward compatibility for KNOWN later-added fields only (round
+            # 4's cross-epoch handoff state): default to the fresh-init
+            # value.  Anything else missing is a corrupt/foreign checkpoint.
+            if key.split("/")[-1] in ("ho_pay", "ho_epoch"):
+                leaves.append(np.asarray(jax.device_get(leaf)))
+                continue
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]
         if arr.shape != leaf.shape:

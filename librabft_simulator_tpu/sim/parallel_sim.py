@@ -187,16 +187,14 @@ def _node_earliest(p, st):
 
 
 def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
-    """One window: every node whose earliest event falls below its *own*
-    lookahead horizon processes that event.
+    """One window: every node whose earliest event falls inside the global
+    conservative window ``[t_min, t_min + d_min)`` processes that event.
 
-    Per-node horizon (Chandy-Misra): node ``a`` may safely process any event
-    strictly earlier than ``min_{b != a} t_ev[b] + d_min`` — the earliest time
-    a message emitted by any other node's pending work could reach it.  This
-    is strictly wider than the classic global window ``[t_min, t_min+d_min)``
-    (a node ahead of the pack keeps draining its backlog instead of idling),
-    which directly raises window occupancy = useful events per step.  The
-    min-excluding-self is computed from the global min and second-min."""
+    (A per-node ``min_{b != a} t_ev[b] + d_min`` horizon was tried and is
+    provably equivalent when each node processes at most one event per
+    window: it only widens the window of the unique global-minimum node,
+    whose earliest event is already inside the global window.  A genuinely
+    wider window needs multi-event draining per node per step.)"""
     n = p.n_nodes
     ic = inbox_cap(p)
     F = payload_width(p)
@@ -206,10 +204,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     halt = st.halted | (t_min > st.max_clock)
     live = ~halt
     clock = jnp.maximum(st.clock, jnp.minimum(t_min, NEVER - 1))
-    uniq_min = jnp.sum(t_ev == t_min) == 1
-    t_second = jnp.min(jnp.where(t_ev == t_min, NEVER, t_ev))
-    min_excl_self = jnp.where((t_ev == t_min) & uniq_min, t_second, t_min)
-    horizon = jnp.minimum(min_excl_self, NEVER - d_min) + d_min  # [N]
+    horizon = jnp.minimum(t_min, NEVER - d_min) + d_min
     active = live & (t_ev < horizon)  # [N]
     # Never process events beyond max_clock inside a window that started
     # before it (they halt the next step).

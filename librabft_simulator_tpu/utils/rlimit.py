@@ -1,0 +1,27 @@
+"""Process-limit helpers importable BEFORE jax (stdlib only).
+
+XLA/LLVM recursion while compiling or (de)serializing this repo's largest
+scan programs can overflow the default 8 MB C stack.  The main thread's
+stack grows on demand up to RLIMIT_STACK, so raising the soft limit before
+the first compile is sufficient.  Shared by tests/conftest.py, bench.py and
+__graft_entry__.py.
+"""
+
+from __future__ import annotations
+
+DEFAULT_STACK_BYTES = 512 * 1024 * 1024
+
+
+def raise_stack_limit(want: int = DEFAULT_STACK_BYTES) -> None:
+    """Raise the RLIMIT_STACK soft limit to ``want`` (capped by the hard
+    limit); a no-op on platforms or containers where that's not possible."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
+        if soft != resource.RLIM_INFINITY and soft < want:
+            new_soft = want if hard == resource.RLIM_INFINITY \
+                else min(want, hard)
+            resource.setrlimit(resource.RLIMIT_STACK, (new_soft, hard))
+    except (ImportError, ValueError, OSError):
+        pass

@@ -14,14 +14,23 @@ import subprocess
 import sys
 
 SHAPES = [
-    # (engine, SimParams kwargs) — the structural shapes the suite compiles.
-    ("serial", {}),                                       # defaults (parity)
-    ("serial", {"n_nodes": 4}),
-    ("serial", {"n_nodes": 4, "window": 8, "chain_k": 2, "commit_log": 16}),
-    ("serial", {"n_nodes": 3, "commands_per_epoch": 6}),  # epoch handoff
-    ("parallel", {"n_nodes": 4, "window": 8, "chain_k": 2, "commit_log": 16}),
-    ("parallel", {"n_nodes": 3, "commands_per_epoch": 6}),
-    ("parallel", {"n_nodes": 4}),
+    # (engine, SimParams kwargs, batch) — representative heavy shapes from
+    # the suite.  Batch size is part of the compiled shape: batch=None means
+    # an UNBATCHED single-instance run (how the parity tests drive the
+    # serial engine); the parallel entries mirror tests/test_parallel_sim.py
+    # small_params batches and tests/test_epoch_handoff.py boundary_params.
+    ("serial", {}, None),                                 # parity default
+    ("serial", {"n_nodes": 4}, None),
+    ("serial", {"n_nodes": 3, "commands_per_epoch": 6}, None),  # handoff
+    ("parallel",
+     {"n_nodes": 4, "delay_kind": "uniform", "window": 8, "chain_k": 2,
+      "commit_log": 16}, 6),
+    ("parallel",
+     {"n_nodes": 4, "delay_kind": "uniform", "window": 8, "chain_k": 2,
+      "commit_log": 16}, 8),
+    ("parallel",
+     {"n_nodes": 3, "commands_per_epoch": 6, "delay_kind": "uniform",
+      "drop_prob": 0.1, "window": 16, "chain_k": 4}, 8),
 ]
 
 CHILD = r"""
@@ -42,30 +51,34 @@ from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.sim import parallel_sim, simulator
 from librabft_simulator_tpu.sim.simulator import dedupe_buffers
 
-engine_name, kw = json.loads(sys.argv[1])
+engine_name, kw, batch = json.loads(sys.argv[1])
 engine = parallel_sim if engine_name == "parallel" else simulator
 p = SimParams(max_clock=500, **kw)
-st = dedupe_buffers(engine.init_batch(p, np.arange(4, dtype=np.uint32)))
-run = engine.make_run_fn(p, 256)
+if batch is None:
+    st = dedupe_buffers(engine.init_state(p, 0))
+    run = engine.make_run_fn(p, 256, batched=False)
+else:
+    st = dedupe_buffers(engine.init_batch(p, np.arange(batch, dtype=np.uint32)))
+    run = engine.make_run_fn(p, 256)
 jax.block_until_ready(run(st))
-print("warmed", engine_name, kw)
+print("warmed", engine_name, kw, batch)
 """
 
 
 def main():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if "--list" in sys.argv:
-        for e, kw in SHAPES:
-            print(e, kw)
+        for e, kw, b in SHAPES:
+            print(e, kw, b)
         return
     import json
 
-    for e, kw in SHAPES:
+    for e, kw, b in SHAPES:
         r = subprocess.run(
             [sys.executable, "-c", CHILD % {"root": root},
-             json.dumps([e, kw])],
+             json.dumps([e, kw, b])],
             cwd=root)
-        print(f"[warm_cache] {e} {kw}: rc={r.returncode}", flush=True)
+        print(f"[warm_cache] {e} {kw} b={b}: rc={r.returncode}", flush=True)
 
 
 if __name__ == "__main__":

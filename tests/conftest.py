@@ -1,21 +1,12 @@
 import os
 
-# XLA/LLVM recursion while compiling (or serializing) this repo's largest
-# scan programs overflows the default 8 MB C stack — observed as wandering
-# SIGSEGVs in backend_compile / executable.serialize().  The main thread's
-# stack grows on demand up to RLIMIT_STACK, so raising the soft limit early
-# is sufficient.
-import resource
+import sys
 
-_soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
-_want = 512 * 1024 * 1024
-if _soft != resource.RLIM_INFINITY and _soft < _want:
-    try:
-        resource.setrlimit(resource.RLIMIT_STACK, (
-            _want if _hard == resource.RLIM_INFINITY else min(_want, _hard),
-            _hard))
-    except (ValueError, OSError):
-        pass
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from librabft_simulator_tpu.utils.rlimit import raise_stack_limit  # noqa: E402
+
+raise_stack_limit()
 
 # Virtual 8-device CPU mesh for tests; must happen before any jax computation.
 # (The axon TPU plugin ignores the JAX_PLATFORMS env var, so we also set the

@@ -42,3 +42,24 @@ def test_batched_checkpoint(tmp_path):
     st2 = C.load(f, p, like=S.init_batch(p, np.zeros(4, np.uint32)))
     for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st2)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_checkpoint_missing_new_fields(tmp_path):
+    """Checkpoints written before a SimState field existed still load: the
+    absent leaves default to their freshly-initialised values."""
+    p = SimParams(n_nodes=3, max_clock=300)
+    st = S.run_to_completion(p, S.init_state(p, 3))
+    f = str(tmp_path / "old.npz")
+    C.save(f, st)
+    # Simulate an old checkpoint: strip the round-4 handoff leaves.
+    data = dict(np.load(f))
+    stripped = {k: v for k, v in data.items() if not k.startswith("ho_")}
+    assert len(stripped) < len(data)
+    np.savez_compressed(f, **stripped)
+    st2 = C.load(f, p, like=S.init_state(p, 0))
+    like = S.init_state(p, 0)
+    np.testing.assert_array_equal(np.asarray(st2.ho_epoch),
+                                  np.asarray(like.ho_epoch))
+    assert int(st2.n_events) == int(st.n_events)
+    np.testing.assert_array_equal(np.asarray(st2.ctx.commit_count),
+                                  np.asarray(st.ctx.commit_count))
