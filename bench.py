@@ -238,6 +238,14 @@ def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
     # the timed window: with the handoff machinery off the trajectories are
     # bit-identical and the step graph is smaller.  Recorded in the output.
     params_kw.setdefault("epoch_handoff", False)
+    # BENCH_SELECT=pallas A/Bs the fused event-select kernel on TPU.  The
+    # compiled kernel cannot run on the CPU backend, so any CPU fallback
+    # (dead tunnel, attach timeout, in-run failure rerun) downgrades to the
+    # XLA select rather than poisoning the fallback contract line.
+    select = os.environ.get("BENCH_SELECT", "xla")
+    if select == "pallas" and jax.devices()[0].platform == "cpu":
+        select = "xla"
+    params_kw.setdefault("select_kernel", select)
     p = SimParams(
         n_nodes=n_nodes,
         delay_kind=delay_kind,
@@ -247,7 +255,10 @@ def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
     )
     res = _time_engine(engine, p, batch, chunk, reps, init_kw=init_kw)
     res.update(instances=batch, n_nodes=n_nodes, steps=chunk * reps,
-               engine=engine_name, epoch_handoff=p.epoch_handoff)
+               engine=engine_name, epoch_handoff=p.epoch_handoff,
+               # Only the serial engine has a select_kernel code path.
+               select_kernel=(p.select_kernel if engine_name == "serial"
+                              else "n/a"))
     return res
 
 
@@ -280,6 +291,7 @@ def run_all() -> dict:
         "compile_s": round(head["compile_s"], 1),
         "overflow_frac": head["overflow_frac"],
         "epoch_handoff": head["epoch_handoff"],
+        "select_kernel": head["select_kernel"],
         "instances": head["instances"],
         "n_nodes": head["n_nodes"],
         "platform": platform,

@@ -87,6 +87,11 @@ class SimParams:
     epoch_handoff: bool = True  # serve one-epoch-behind requesters the
                                 # previous epoch's K-tail (data_sync.rs:82-92,
                                 # node.rs record_store_at); off = laggards jump
+    # Event selection backend for the serial engine: "xla" (default, fused
+    # masked reductions), "pallas" (ops/pallas_queue.py TPU kernel), or
+    # "pallas_interpret" (same kernel, interpreter mode — CPU testable).
+    # All three are bit-identical (tests/test_ops.py).
+    select_kernel: str = "xla"
     # Network.
     shuffle_receivers: bool = False  # seeded per-event receiver permutation
                                      # (simulator.rs:343 fuzzing semantics);

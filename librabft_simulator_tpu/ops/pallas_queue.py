@@ -11,6 +11,11 @@ Inputs are padded to a lane-aligned M (invalid entries carry time=NEVER), so
 the fleet event-select runs as a single grid over instance blocks.  On CPU the
 same kernel runs in interpret mode — bit-identical, which keeps the parity
 suite meaningful.
+
+Call site: ``sim/simulator.py::_select_event`` with
+``SimParams.select_kernel`` in {"pallas", "pallas_interpret"} (the engine's
+vmap batches the per-instance call over the fleet); ``BENCH_SELECT=pallas``
+selects it for on-chip A/B against the XLA reductions.
 """
 
 from __future__ import annotations

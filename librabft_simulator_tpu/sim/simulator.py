@@ -119,12 +119,24 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
 
 
 def _select_event(p: SimParams, st: SimState):
-    """Lexicographic (time, kind desc, stamp) argmin over messages + timers."""
+    """Lexicographic (time, kind desc, stamp) argmin over messages + timers.
+
+    ``SimParams.select_kernel`` picks the backend: plain-XLA masked
+    reductions (default) or the fused Pallas kernel (ops/pallas_queue.py);
+    all backends are bit-identical (tests/test_ops.py)."""
     cm = p.queue_cap
     msg_time = jnp.where(st.queue.valid, st.queue.time, NEVER)
     all_time = jnp.concatenate([msg_time, st.timer_time])
     all_kind = jnp.concatenate([st.queue.kind, jnp.full((p.n_nodes,), KIND_TIMER, I32)])
     all_stamp = jnp.concatenate([st.queue.stamp, st.timer_stamp])
+    if p.select_kernel.startswith("pallas"):
+        from ..ops.pallas_queue import select_events
+
+        idx_b, tmin_b = select_events(
+            all_time[None], all_kind[None], all_stamp[None], block_b=1,
+            interpret=(p.select_kernel == "pallas_interpret"))
+        idx = idx_b[0].astype(I32)
+        return idx, tmin_b[0], idx >= cm
     t_min = jnp.min(all_time)
     c1 = all_time == t_min
     k_best = jnp.max(jnp.where(c1, all_kind, -1))
