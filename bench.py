@@ -236,7 +236,9 @@ def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
     # The benched workloads commit a few hundred times per instance, far
     # below commands_per_epoch=30000, so no epoch boundary can occur inside
     # the timed window: with the handoff machinery off the trajectories are
-    # bit-identical and the step graph is smaller.  Recorded in the output.
+    # bit-identical and the step graph is smaller (measured on CPU at
+    # B=2048: 15% runtime + 5x compile-time tax when left on).  Recorded in
+    # the output.
     params_kw.setdefault("epoch_handoff", False)
     # BENCH_SELECT=pallas A/Bs the fused event-select kernel on TPU.  The
     # compiled kernel cannot run on the CPU backend, so any CPU fallback
