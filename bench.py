@@ -62,7 +62,13 @@ def _tunnel_listening() -> bool:
     instead of burning the attach watchdog."""
     import socket
 
-    for port in (8082, 8083, 8087):
+    # Known relay ports of the loopback tunnel; overridable if the relay
+    # moves (a wrong list would demote a healthy TPU run to CPU).
+    ports = tuple(
+        int(x) for x in
+        os.environ.get("BENCH_TUNNEL_PORTS", "8082,8083,8087").split(",")
+        if x.strip()) or (8082, 8083, 8087)
+    for port in ports:
         try:
             with socket.create_connection(("127.0.0.1", port), timeout=5.0):
                 return True
@@ -214,7 +220,18 @@ def _time_engine(engine, p, batch, chunk, reps, init_kw=None):
     lost_field = st.n_queue_full if hasattr(st, "n_queue_full") else st.n_inbox_full
     lost = int(np.sum(jax.device_get(lost_field)))
     sent = int(np.sum(jax.device_get(st.n_msgs_sent)))
+    max_epoch = int(np.max(jax.device_get(st.store.epoch_id)))
+    if not p.epoch_handoff:
+        # The handoff machinery is benched off on the premise that no epoch
+        # boundary occurs inside the timed window (commit counts stay far
+        # below commands_per_epoch).  Verify it: a workload change that
+        # crosses a boundary would otherwise silently bench a config that
+        # can deadlock at boundaries (test_epoch_handoff.py).
+        assert max_epoch == 0, (
+            f"bench crossed an epoch boundary (max epoch {max_epoch}) with "
+            "epoch_handoff=False; re-bench with the default handoff config")
     return {
+        "max_epoch": max_epoch,
         "rounds_per_sec": (r1 - r0) / dt,
         "commits_per_sec": (c1 - c0) / dt,
         "events_per_sec": (e1 - e0) / dt,
@@ -247,7 +264,8 @@ def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
     select = os.environ.get("BENCH_SELECT", "xla")
     if select == "pallas" and jax.devices()[0].platform == "cpu":
         select = "xla"
-    params_kw.setdefault("select_kernel", select)
+    if engine_name == "serial":  # the parallel engine has no select path
+        params_kw.setdefault("select_kernel", select)
     p = SimParams(
         n_nodes=n_nodes,
         delay_kind=delay_kind,

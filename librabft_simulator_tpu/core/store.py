@@ -423,6 +423,12 @@ def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
     weight reaches quorum, and (c) the QC content tag recomputes from the
     carried fields *including the mask* — the tag plays the role of the
     aggregate signature, so a forged mask or tampered field breaks it.
+    Trust-model boundary: the tag is a hash, not a signature — a forger who
+    recomputes the tag over a fabricated full-quorum mask passes these
+    checks.  That mirrors the reference simulator's simulated-crypto model
+    (hashes stand in for aggregate signatures); the stronger claim —
+    unforgeable per-vote authentication — lives in the realnode stack
+    (realnode/crypto.py, real Ed25519 over the wire).
     (Divergence note: on a failed state re-execution the reference leaves
     the QC in its map but skips the computed-value updates; we reject it
     entirely.)"""

@@ -97,6 +97,12 @@ class SimParams:
                                      # (simulator.rs:343 fuzzing semantics);
                                      # parity trio only (serial/oracle/C++)
     inbox_cap: int = 0        # parallel engine per-receiver slots (0 = auto)
+    # Parallel-engine window shape (see sim/parallel_sim.py): nodes stepped
+    # densely per window after compaction, and events each lane may drain.
+    # Both only reshape windows — trajectories are invariant absent inbox
+    # overflow (tests/test_parallel_sim.py).  0 = auto heuristics.
+    active_lanes: int = 0
+    drain_k: int = 0
     delay_kind: str = "lognormal"
     delay_mean: float = 10.0
     delay_variance: float = 4.0

@@ -58,10 +58,16 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
         )
         if key not in data:
             # Forward compatibility for KNOWN later-added fields only (round
-            # 4's cross-epoch handoff state): default to the fresh-init
-            # value.  Anything else missing is a corrupt/foreign checkpoint.
-            if key.split("/")[-1] in ("ho_pay", "ho_epoch"):
-                leaves.append(np.asarray(jax.device_get(leaf)))
+            # 4's cross-epoch handoff state): synthesize the fresh-init
+            # default explicitly — ``like`` may be mid-run, and copying its
+            # leaf would inject stale handoff state into the restore.
+            # Anything else missing is a corrupt/foreign checkpoint.
+            field = key.split("/")[-1]
+            if field == "ho_pay":
+                leaves.append(np.zeros(leaf.shape, leaf.dtype))
+                continue
+            if field == "ho_epoch":
+                leaves.append(np.full(leaf.shape, -1, leaf.dtype))
                 continue
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]

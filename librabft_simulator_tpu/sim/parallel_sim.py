@@ -1,33 +1,61 @@
-"""Conservative-window parallel engine: N nodes per instance step together.
+"""Lane-compacted conservative-window parallel engine.
 
 The serial engine (:mod:`.simulator`) replays the reference's event loop one
 event at a time — the parity reference.  This engine is the throughput mode:
 classic conservative parallel discrete-event simulation (PDES) with network
-lookahead, re-expressed for TPU.
+lookahead (match: the capability bar of
+/root/reference/bft-lib/src/simulator.rs:26-160, where one BinaryHeap serves
+64-node fleets), re-expressed for TPU.
 
 Correctness argument (standard Chandy-Misra lookahead): nodes influence each
 other ONLY via messages, and every message has latency >= ``d_min`` (the
-minimum of the delay table, floored to 1 here).  Hence all events with
-timestamps in the window ``[t_min, t_min + d_min)`` at *different* nodes are
-causally independent and may be processed concurrently; same-node causality
-is preserved by processing at most one event per node per step (a node's
-events are totally ordered by (time, kind desc, stamp)).  The messages they
-emit arrive at or after ``t_min + d_min``, i.e. outside the window.
+minimum of the delay table, floored to 1).  With ``t_min`` the earliest
+pending event anywhere, every in-window send happens at some t >= t_min and
+arrives at >= t_min + d_min — so events strictly below the global horizon
 
-TPU shape: per-receiver inboxes ``[N, IC]`` instead of one global queue; the
-whole per-node protocol machinery (data-sync handlers + update_node) runs
-under ``jax.vmap`` over the node axis — the same XLA kernels as the serial
-engine now do up to N instances' worth of useful work per launch, which is
-what makes 64-node fleets (BASELINE config #3) tractable.
+    hz = t_min + d_min
+
+cannot be affected by any in-window work (one hop arrives at >= hz; a
+two-hop reply at >= t_min + 2*d_min; and so on).  Each node may therefore
+drain ALL its pending events below ``hz`` in local (time, kind desc, stamp)
+order without hearing from anyone.  The horizon must be global: a per-node
+min-over-*others* horizon is unsound under draining, because a node's own
+send at t can spawn another node's event at t + d_min whose reply lands back
+at t + 2*d_min — inside the wider per-node window (caught bit-exactly by
+tests/test_parallel_sim.py's composition-invariance tests).
+
+TPU shape — the two ideas that make this fast rather than merely correct:
+
+* **Lane compaction.**  A vmap over all N nodes pays N× the per-node update
+  cost per window even when only a couple of nodes have work (masked lanes
+  still compute).  Instead the window's work is compacted onto ``A =
+  lanes_of(p)`` *lanes*: the A earliest qualifying nodes (stable argsort of
+  earliest-event times) are gathered, stepped densely, and scattered back.
+  Cost per window is A× update_node, not N×, and A is sized to typical
+  window occupancy, not fleet width.
+* **Multi-event draining.**  Each lane drains up to ``K = drain_of(p)`` of
+  its node's events per window under an inner ``lax.scan`` — the same-node
+  chain is inherently sequential (event i+1 sees event i's state), but K
+  same-node events now cost one window's fixed overhead (selection,
+  compaction, routing) instead of K windows'.  Burst arrivals (a round's
+  broadcast landing on one node at equal timestamps) drain in one window.
+
+Per-receiver inboxes ``[N, IC]`` replace the serial engine's shared queue;
+candidate messages are ranked per receiver with O(K·A·n) column cumsums and
+scattered into free slots (overflow counted, never silent).
 
 Determinism: rng/stamps are node-local counters (stamp stream ``ctr*N+n``),
-so trajectories are bit-reproducible for a seed (CPU == TPU), independent of
-how many nodes happen to share a window — ``tests/test_parallel_sim.py``
-asserts this bit-exactly by shrinking the lookahead.  They are NOT the serial
-engine's trajectories (different stamp interleaving): the serial engine
-remains the oracle-parity reference, and the same test file checks this
-engine statistically against it (commit/event density per unit virtual time)
-plus safety under Byzantine masks and inbox-overflow accounting.
+so trajectories are bit-reproducible for a seed (CPU == TPU) and — absent
+inbox overflow — *independent of window composition*: lookahead ``d_min``,
+lane count, and drain depth only decide how much work lands in each step,
+never the per-node event order.  ``tests/test_parallel_sim.py`` asserts this
+bit-exactly across d_min/lanes/drain variants.  Trajectories are NOT the
+serial engine's (different stamp interleaving): the serial engine remains
+the oracle-parity reference, and the same test file checks this engine
+statistically against it (commit/event density per unit virtual time) plus
+Byzantine safety and overflow accounting.  (Under overflow the window shape
+changes which concurrent sends compete for free slots, so the discarded set
+— and hence the trajectory — may differ.)
 """
 
 from __future__ import annotations
@@ -62,6 +90,13 @@ from ..utils.quantile import TABLE_BITS
 
 I32 = jnp.int32
 EQUIV_SALT = 1 << 20
+
+# Debug hook: set to a host callable before tracing to receive
+# (act, t, kind, node, is_timer, ctr, t_ev, hz, qualify) per drain
+# iteration — lane arrays first, then the window-level selection inputs
+# (unbatched runs only; one ordered callback site so host-side window/
+# iteration alignment is exact).  None (default) compiles to nothing.
+_debug_tap = None
 
 
 def _i32(x):
@@ -116,8 +151,35 @@ def inbox_cap(p: SimParams) -> int:
     return p.inbox_cap if p.inbox_cap > 0 else max(16, 4 * p.n_nodes)
 
 
+def lanes_of(p: SimParams) -> int:
+    """Active lanes per window: nodes stepped densely after compaction.
+    ``SimParams.active_lanes`` if set, else min(n, max(8, n/4)) — sized to
+    typical window occupancy (CPU probe, uniform delays: ~24 events/window
+    at n=16, ~120 at n=64; A=16/K=8 beat A=8/K=4 by 1.3x at n=64)."""
+    if p.active_lanes > 0:
+        return min(p.n_nodes, p.active_lanes)
+    return min(p.n_nodes, max(8, p.n_nodes // 4))
+
+
+def drain_of(p: SimParams) -> int:
+    """Events each lane may drain per window (same-node chain, sequential).
+    Bigger fleets see deeper same-node bursts (a round's n-1 notifies)."""
+    return p.drain_k if p.drain_k > 0 else (4 if p.n_nodes <= 16 else 8)
+
+
 def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
                byz_silent=None, byz_forge_qc=None) -> PSimState:
+    if p.shuffle_receivers:
+        raise NotImplementedError(
+            "SimParams.shuffle_receivers is a parity-trio semantic "
+            "(serial/oracle/C++); the parallel engine delivers in index "
+            "order — use the serial engine for shuffle fuzzing.")
+    if p.select_kernel != "xla":
+        import warnings
+
+        warnings.warn(
+            f"select_kernel={p.select_kernel!r} is ignored by the parallel "
+            "engine (no shared event queue to select from)", stacklevel=2)
     n = p.n_nodes
     ic = inbox_cap(p)
     F = payload_width(p)
@@ -165,207 +227,281 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
     )
 
 
-def _node_earliest(p, st):
-    """Per node: earliest pending event by (time, kind desc, stamp).
+def _earliest(in_valid, in_time, in_kind, in_stamp, timer_time):
+    """Per row: earliest pending event by (time, kind desc, stamp).
 
-    Returns (time[N], kind[N], slot[N], is_timer[N]); slot = inbox slot
-    (or -1 for timer)."""
-    msg_time = jnp.where(st.in_valid, st.in_time, NEVER)
-    t_best = jnp.minimum(jnp.min(msg_time, axis=1), st.timer_time)  # [N]
+    Returns (time, kind, slot, is_timer) with leading dim = rows; slot is the
+    inbox slot (or -1 for a timer).  Timer wins at equal (time, kind=3):
+    timers and messages never share a kind (messages are 0..2)."""
+    msg_time = jnp.where(in_valid, in_time, NEVER)
+    t_best = jnp.minimum(jnp.min(msg_time, axis=1), timer_time)
     m1 = msg_time == t_best[:, None]
-    k_msg = jnp.max(jnp.where(m1, st.in_kind, -1), axis=1)
-    timer_due = st.timer_time == t_best
+    k_msg = jnp.max(jnp.where(m1, in_kind, -1), axis=1)
+    timer_due = timer_time == t_best
     k_best = jnp.maximum(k_msg, jnp.where(timer_due, KIND_TIMER, -1))
-    m2 = m1 & (st.in_kind == k_best[:, None])
-    s_best = jnp.min(jnp.where(m2, st.in_stamp, NEVER), axis=1)
-    # Timer wins at equal (time, kind=3): timers and messages never share a
-    # kind (messages are 0..2), so k_best==3 <=> timer.
+    m2 = m1 & (in_kind == k_best[:, None])
+    s_best = jnp.min(jnp.where(m2, in_stamp, NEVER), axis=1)
     is_timer = timer_due & (k_best == KIND_TIMER)
-    slot = jnp.argmax(m2 & (st.in_stamp == s_best[:, None]), axis=1).astype(I32)
+    slot = jnp.argmax(m2 & (in_stamp == s_best[:, None]), axis=1).astype(I32)
     slot = jnp.where(is_timer, -1, slot)
     return t_best, k_best, slot, is_timer
 
 
 def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
-    """One window: every node whose earliest event falls inside the global
-    conservative window ``[t_min, t_min + d_min)`` processes that event.
-
-    (A per-node ``min_{b != a} t_ev[b] + d_min`` horizon was tried and is
-    provably equivalent when each node processes at most one event per
-    window: it only widens the window of the unique global-minimum node,
-    whose earliest event is already inside the global window.  A genuinely
-    wider window needs multi-event draining per node per step.)"""
+    """One window: compact the A earliest qualifying nodes onto lanes, drain
+    up to K events per lane, then route all emitted messages at once."""
     n = p.n_nodes
     ic = inbox_cap(p)
     F = payload_width(p)
+    A = lanes_of(p)
+    K = drain_of(p)
+    nc = 2 * n + 1
 
-    t_ev, k_ev, slot, is_timer = _node_earliest(p, st)
+    # ---- Window bookkeeping: per-node earliest times, global horizon.
+    # The horizon must be GLOBAL (t_min + d_min), not per-node: with
+    # multi-event draining, a node's own in-window send at t can trigger
+    # another node's event at t + d_min whose *reply* lands back at
+    # t + 2*d_min — so any event at or beyond t_min + d_min may causally
+    # depend on in-window work.  Events strictly below t_min + d_min cannot
+    # (every in-window send arrives at >= t_min + d_min), which makes the
+    # global window safe for draining K same-node events.  (A per-node
+    # min-over-others horizon is sound only for one-event-per-node windows,
+    # where each node processes an event that precedes every other node's
+    # first possible send.)
+    msg_time = jnp.where(st.in_valid, st.in_time, NEVER)
+    t_ev = jnp.minimum(jnp.min(msg_time, axis=1), st.timer_time)  # [N]
     t_min = jnp.min(t_ev)
     halt = st.halted | (t_min > st.max_clock)
     live = ~halt
     clock = jnp.maximum(st.clock, jnp.minimum(t_min, NEVER - 1))
-    horizon = jnp.minimum(t_min, NEVER - d_min) + d_min
-    active = live & (t_ev < horizon)  # [N]
-    # Never process events beyond max_clock inside a window that started
-    # before it (they halt the next step).
-    active = active & (t_ev <= st.max_clock)
+    hz = jnp.minimum(t_min, NEVER - d_min) + d_min  # scalar
+    qualify = live & (t_ev < hz) & (t_ev <= st.max_clock)
 
-    slot_c = jnp.maximum(slot, 0)
-    pay_rows = jnp.take_along_axis(st.in_pay, slot_c[:, None, None], axis=1)[:, 0]
-    sender = jnp.take_along_axis(st.in_sender, slot_c[:, None], axis=1)[:, 0]
-    # Consume selected inbox slots.
-    consume = active & ~is_timer
-    in_valid = st.in_valid.at[jnp.arange(n), slot_c].set(
-        jnp.where(consume, False, st.in_valid[jnp.arange(n), slot_c]))
+    # ---- Lane compaction: the A earliest qualifying nodes (ties by index).
+    sort_key = jnp.where(qualify, t_ev, NEVER)
+    sel = jnp.argsort(sort_key, stable=True)[:A].astype(I32)  # [A] node ids
+    lane_on = qualify[sel]
+    lane_startup = st.startup[sel]
+    lane_silent = st.byz_silent[sel]
+    lane_equiv = st.byz_equivocate[sel]
+    lane_forge = st.byz_forge_qc[sel]
+    others_l = sel[:, None] != jnp.arange(n)[None, :]  # [A, n]
+    # Loop constants: drains only flip in_valid; times/kinds/stamps/payloads
+    # of already-queued messages never change mid-window.
+    g_it = st.in_time[sel]
+    g_ik = st.in_kind[sel]
+    g_is = st.in_stamp[sel]
+    g_isnd = st.in_sender[sel]
+    g_ipay = st.in_pay[sel]
 
-    is_notify = active & ~is_timer & (k_ev == KIND_NOTIFY)
-    is_request = active & ~is_timer & (k_ev == KIND_REQUEST)
-    is_response = active & ~is_timer & (k_ev == KIND_RESPONSE)
-    do_update = active & (is_timer | is_notify | is_response)
-    local_clock = t_ev - st.startup  # each node handles its own event time
+    def drain_iter(c, _):
+        (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
+         ev_n, drop_n) = c
+        t_l, k_l, slot_l, is_tm = _earliest(g_iv, g_it, g_ik, g_is, g_timer)
+        act = lane_on & (t_l < hz) & (t_l <= st.max_clock)
+        slot_c = jnp.maximum(slot_l, 0)
+        pay_rows = jnp.take_along_axis(g_ipay, slot_c[:, None, None], axis=1)[:, 0]
+        sender = jnp.take_along_axis(g_isnd, slot_c[:, None], axis=1)[:, 0]
+        consume = act & ~is_tm
+        g_iv = g_iv.at[jnp.arange(A), slot_c].set(
+            jnp.where(consume, False, g_iv[jnp.arange(A), slot_c]))
 
-    def per_node(a, s_a, pm_a, nx_a, cx_a, pay_row, lclk, ho_row, ho_ep):
-        pay_in = unpack_payload(p, pay_row)
-        s_n, should_sync = data_sync.handle_notification(p, s_a, st.weights, pay_in)
-        s_r, nx_r, cx_r = data_sync.handle_response(p, s_a, nx_a, cx_a,
-                                                    st.weights, pay_in)
-        s_in = store_ops._sel(is_notify[a], s_n,
-                              store_ops._sel(is_response[a], s_r, s_a))
-        nx_in = store_ops._sel(is_response[a], nx_r, nx_a)
-        cx_in = store_ops._sel(is_response[a], cx_r, cx_a)
-        s_u, pm_u, nx_u, cx_u, actions = node_ops.update_node(
-            p, s_in, pm_a, nx_in, cx_in, st.weights, a, lclk, dur_table)
-        s_f = store_ops._sel(do_update[a], s_u, s_in)
-        pm_f = store_ops._sel(do_update[a], pm_u, pm_a)
-        nx_f = store_ops._sel(do_update[a], nx_u, nx_in)
-        cx_f = store_ops._sel(do_update[a], cx_u, cx_in)
-        notif = data_sync.create_notification(p, s_f, a)
-        notif = store_ops._sel(st.byz_forge_qc[a],
-                               _forged_qc_payload(p, s_f, a, notif), notif)
-        request = data_sync.create_request(p, s_f)
-        response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
-        resp_packed = pack_payload(response)
-        if p.epoch_handoff:
-            # Cross-epoch handoff (mirrors sim/simulator.py): capture the
-            # pack update_node built from the post-update, pre-switch store;
-            # serve it to requesters still in that epoch.
-            switched = do_update[a] & actions.ho_switched
-            ho_row = jnp.where(switched, actions.ho_pack, ho_row)
-            ho_ep = jnp.where(switched, actions.ho_epoch, ho_ep)
-            serve_ho = (is_request[a] & (pay_in.epoch == ho_ep)
-                        & (pay_in.epoch < s_f.epoch_id))
-            resp_row = jnp.where(serve_ho, ho_row, resp_packed)
-        else:
-            resp_row = resp_packed
-        notif_p = pack_payload(notif)
-        bank = jnp.stack([
-            notif_p,
-            pack_payload(_equivocate(p, notif)),
-            pack_payload(request),
-            resp_row,
-        ])
-        return s_f, pm_f, nx_f, cx_f, actions, should_sync, bank, ho_row, ho_ep
+        is_notify = act & ~is_tm & (k_l == KIND_NOTIFY)
+        is_request = act & ~is_tm & (k_l == KIND_REQUEST)
+        is_response = act & ~is_tm & (k_l == KIND_RESPONSE)
+        do_update = act & (is_tm | is_notify | is_response)
+        lclk = t_l - lane_startup  # each lane handles its own event time
 
-    (s_f, pm_f, nx_f, cx_f, actions, should_sync, banks, ho_pay,
-     ho_epoch) = jax.vmap(per_node)(
-        jnp.arange(n), st.store, st.pm, st.node, st.ctx, pay_rows, local_clock,
-        st.ho_pay, st.ho_epoch)
+        def per_lane(i, s_a, pm_a, nx_a, cx_a, pay_row, lc, ho_row, ho_ep):
+            a = sel[i]
+            pay_in = unpack_payload(p, pay_row)
+            s_n, should_sync = data_sync.handle_notification(
+                p, s_a, st.weights, pay_in)
+            s_r, nx_r, cx_r = data_sync.handle_response(
+                p, s_a, nx_a, cx_a, st.weights, pay_in)
+            s_in = store_ops._sel(is_notify[i], s_n,
+                                  store_ops._sel(is_response[i], s_r, s_a))
+            nx_in = store_ops._sel(is_response[i], nx_r, nx_a)
+            cx_in = store_ops._sel(is_response[i], cx_r, cx_a)
+            s_u, pm_u, nx_u, cx_u, actions = node_ops.update_node(
+                p, s_in, pm_a, nx_in, cx_in, st.weights, a, lc, dur_table)
+            s_f = store_ops._sel(do_update[i], s_u, s_in)
+            pm_f = store_ops._sel(do_update[i], pm_u, pm_a)
+            nx_f = store_ops._sel(do_update[i], nx_u, nx_in)
+            cx_f = store_ops._sel(do_update[i], cx_u, cx_in)
+            notif = data_sync.create_notification(p, s_f, a)
+            notif = store_ops._sel(lane_forge[i],
+                                   _forged_qc_payload(p, s_f, a, notif), notif)
+            request = data_sync.create_request(p, s_f)
+            response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
+            resp_packed = pack_payload(response)
+            if p.epoch_handoff:
+                # Cross-epoch handoff (mirrors sim/simulator.py): capture the
+                # pack update_node built from the post-update, pre-switch
+                # store; serve it to requesters still in that epoch.
+                switched = do_update[i] & actions.ho_switched
+                ho_row = jnp.where(switched, actions.ho_pack, ho_row)
+                ho_ep = jnp.where(switched, actions.ho_epoch, ho_ep)
+                serve_ho = (is_request[i] & (pay_in.epoch == ho_ep)
+                            & (pay_in.epoch < s_f.epoch_id))
+                resp_row = jnp.where(serve_ho, ho_row, resp_packed)
+            else:
+                resp_row = resp_packed
+            bank = jnp.stack([
+                pack_payload(notif),
+                pack_payload(_equivocate(p, notif)),
+                pack_payload(request),
+                resp_row,
+            ])
+            return (s_f, pm_f, nx_f, cx_f, actions, should_sync, bank,
+                    ho_row, ho_ep)
 
-    # ---- Outgoing candidates: [N senders, 2n+1 candidates].
-    silent = st.byz_silent
-    want_sync_req = is_notify & should_sync & ~silent
-    want_response = is_request & ~silent
-    cand0_want = want_sync_req | want_response
-    cand0_kind = jnp.where(want_response, KIND_RESPONSE, KIND_REQUEST)
-    cand0_recv = jnp.clip(sender, 0, n - 1)
-    others = ~jnp.eye(n, dtype=bool)
-    send_mask = actions.send_mask & others & do_update[:, None] & ~silent[:, None]
-    query_mask = (actions.should_query_all & do_update & ~silent)[:, None] & others
+        (g_store, g_pm, g_nx, g_cx, actions, should_sync, banks, g_hop,
+         g_hoe) = jax.vmap(per_lane)(
+            jnp.arange(A), g_store, g_pm, g_nx, g_cx, pay_rows, lclk,
+            g_hop, g_hoe)
 
-    nc = 2 * n + 1
-    want = jnp.concatenate([cand0_want[:, None], send_mask, query_mask], axis=1)
-    kinds = jnp.concatenate([
-        cand0_kind[:, None],
-        jnp.full((n, n), KIND_NOTIFY, I32),
-        jnp.full((n, n), KIND_REQUEST, I32),
-    ], axis=1)
-    recvs = jnp.concatenate([
-        cand0_recv[:, None],
-        jnp.broadcast_to(jnp.arange(n, dtype=I32), (n, n)),
-        jnp.broadcast_to(jnp.arange(n, dtype=I32), (n, n)),
-    ], axis=1)
-    upper = (jnp.arange(n) * 2 >= n)[None, :]
-    eq_sel = jnp.where(st.byz_equivocate[:, None] & upper, 1, 0)
-    pay_sel = jnp.concatenate([
-        jnp.where(want_response, 3, 2)[:, None],
-        eq_sel,
-        jnp.full((n, n), 2, I32),
-    ], axis=1)
+        # ---- Outgoing candidates: [A lanes, 2n+1 candidates].
+        want_sync_req = is_notify & should_sync & ~lane_silent
+        want_response = is_request & ~lane_silent
+        cand0_want = want_sync_req | want_response
+        cand0_kind = jnp.where(want_response, KIND_RESPONSE, KIND_REQUEST)
+        cand0_recv = jnp.clip(sender, 0, n - 1)
+        send_mask = (actions.send_mask & others_l & do_update[:, None]
+                     & ~lane_silent[:, None])
+        query_mask = ((actions.should_query_all & do_update
+                       & ~lane_silent)[:, None] & others_l)
 
-    # Per-sender stamps: node-local streams (ctr*N + node), disjoint across
-    # nodes so rng draws are deterministic however windows interleave.
-    pos = jnp.cumsum(want, axis=1) - 1
-    timer_gap = jnp.where(do_update, 1, 0)
-    local_idx = st.node_ctr[:, None] + pos + jnp.where(jnp.arange(nc)[None, :] > 0,
-                                                       timer_gap[:, None], 0)
-    stamps = local_idx * n + jnp.arange(n)[:, None]
-    consumed = jnp.sum(want, axis=1) + timer_gap
-    node_ctr = st.node_ctr + jnp.where(active, consumed, 0)
+        want = jnp.concatenate([cand0_want[:, None], send_mask, query_mask],
+                               axis=1)
+        recvs = jnp.concatenate([
+            cand0_recv[:, None],
+            jnp.broadcast_to(jnp.arange(n, dtype=I32), (A, n)),
+            jnp.broadcast_to(jnp.arange(n, dtype=I32), (A, n)),
+        ], axis=1)
+        kinds = jnp.concatenate([
+            cand0_kind[:, None],
+            jnp.full((A, n), KIND_NOTIFY, I32),
+            jnp.full((A, n), KIND_REQUEST, I32),
+        ], axis=1)
+        upper = (jnp.arange(n) * 2 >= n)[None, :]
+        eq_sel = jnp.where(lane_equiv[:, None] & upper, 1, 0)
+        pay_sel = jnp.concatenate([
+            jnp.where(want_response, 3, 2)[:, None],
+            eq_sel,
+            jnp.full((A, n), 2, I32),
+        ], axis=1)
 
-    u_delay = H.rng_u32(st.seed, stamps.astype(jnp.uint32))
-    u_drop = H.mix32(u_delay, jnp.uint32(0x632BE59B))
-    delays = jnp.maximum(delay_table[(u_delay >> (32 - TABLE_BITS)).astype(I32)],
-                         d_min)
-    dropped = want & (u_drop < st.drop_u32)
-    arrive = t_ev[:, None] + delays  # sender's event time + latency
-    go = want & ~dropped
+        # Per-lane stamps: node-local streams (ctr*N + node), disjoint across
+        # nodes so rng draws are deterministic however windows interleave.
+        pos = jnp.cumsum(want, axis=1) - 1
+        timer_gap = jnp.where(do_update, 1, 0)
+        local_idx = g_ctr[:, None] + pos + jnp.where(
+            jnp.arange(nc)[None, :] > 0, timer_gap[:, None], 0)
+        stamps = local_idx * n + sel[:, None]
+        consumed = jnp.sum(want, axis=1) + timer_gap
+        g_ctr = g_ctr + jnp.where(act, consumed, 0)
 
-    # ---- Route to receiver inboxes: flatten all M = N*(2n+1) candidates and
-    # scatter each into its receiver's free slots, ranked in (sender,
-    # candidate) order — deterministic regardless of window composition.
-    M = n * nc
-    flat_go = go.reshape(-1)
-    flat_recv = recvs.reshape(-1)
-    flat_kind = kinds.reshape(-1)
-    flat_stamp = stamps.reshape(-1)
-    flat_arrive = arrive.reshape(-1)
-    flat_sender = jnp.broadcast_to(jnp.arange(n, dtype=I32)[:, None],
-                                   (n, nc)).reshape(-1)
-    flat_paysel = pay_sel.reshape(-1)
+        u_delay = H.rng_u32(st.seed, stamps.astype(jnp.uint32))
+        u_drop = H.mix32(u_delay, jnp.uint32(0x632BE59B))
+        delays = jnp.maximum(
+            delay_table[(u_delay >> (32 - TABLE_BITS)).astype(I32)], d_min)
+        dropped = want & (u_drop < st.drop_u32)
+        arrive = t_l[:, None] + delays  # lane's event time + latency
+        go = want & ~dropped
 
-    recv_onehot = (flat_recv[None, :] == jnp.arange(n)[:, None]) & flat_go[None, :]
-    rank2d = jnp.cumsum(recv_onehot, axis=1) - 1         # [N, M]
-    rank_m = rank2d[flat_recv, jnp.arange(M)]            # [M] rank at receiver
-    free = ~in_valid                                     # [N, IC]
+        # ---- Timer reschedule (sat_add: see types.sat_add).
+        next_g = sat_add(actions.next_sched, lane_startup)
+        g_timer = jnp.where(do_update, jnp.maximum(next_g, t_l + 1), g_timer)
+
+        ev_n = ev_n + jnp.sum(act)
+        drop_n = drop_n + jnp.sum(dropped)
+        if _debug_tap is not None:
+            jax.debug.callback(_debug_tap, act, t_l, k_l, sel, is_tm, g_ctr,
+                               t_ev, hz, qualify, ordered=True)
+        c2 = (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
+              ev_n, drop_n)
+        return c2, (go, kinds, recvs, stamps, arrive, pay_sel, banks)
+
+    slicer = lambda x: x[sel]  # noqa: E731
+    carry0 = (
+        jax.tree.map(slicer, st.store), jax.tree.map(slicer, st.pm),
+        jax.tree.map(slicer, st.node), jax.tree.map(slicer, st.ctx),
+        st.in_valid[sel], st.timer_time[sel], st.node_ctr[sel],
+        st.ho_pay[sel], st.ho_epoch[sel], _i32(0), _i32(0))
+    carryN, ys = jax.lax.scan(drain_iter, carry0, None, length=K)
+    (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe, ev_n,
+     drop_n) = carryN
+    go_k, kind_k, recv_k, stamp_k, arrive_k, paysel_k, bank_k = ys  # [K, A, .]
+
+    # ---- Scatter lane state back (sel indices are distinct; inactive lanes
+    # carried their original values, so unconditional writes are no-ops).
+    put = lambda x, v: x.at[sel].set(v)  # noqa: E731
+    store2 = jax.tree.map(put, st.store, g_store)
+    pm2 = jax.tree.map(put, st.pm, g_pm)
+    nx2 = jax.tree.map(put, st.node, g_nx)
+    cx2 = jax.tree.map(put, st.ctx, g_cx)
+    in_valid = put(st.in_valid, g_iv)
+    timer_time = put(st.timer_time, g_timer)
+    node_ctr = put(st.node_ctr, g_ctr)
+    ho_pay = put(st.ho_pay, g_hop)
+    ho_epoch = put(st.ho_epoch, g_hoe)
+
+    # ---- Route all K*A*(2n+1) candidates to receiver inboxes.  Receiver
+    # rank order is (candidate-block, drain-iter, lane) — deterministic given
+    # state, O(K·A·n) column cumsums instead of an O(N·M) rank matrix.
+    KA = K * A
+    go_f = go_k.reshape(KA, nc)
+    recv_f = recv_k.reshape(KA, nc)
+    go0 = go_f[:, 0]
+    recv0 = jnp.clip(recv_f[:, 0], 0, n - 1)
+    oh0 = (recv0[:, None] == jnp.arange(n)[None, :]) & go0[:, None]  # [KA, n]
+    cnt0 = jnp.sum(oh0, axis=0)                                      # [n]
+    rank0 = (jnp.cumsum(oh0, axis=0) - 1)[jnp.arange(KA), recv0]
+    go1 = go_f[:, 1:n + 1]   # receiver == column
+    go2 = go_f[:, n + 1:]
+    cnt1 = jnp.sum(go1, axis=0)
+    rank1 = cnt0[None, :] + jnp.cumsum(go1, axis=0) - 1
+    rank2 = (cnt0 + cnt1)[None, :] + jnp.cumsum(go2, axis=0) - 1
+    rank = jnp.concatenate([rank0[:, None], rank1, rank2], axis=1)  # [KA, nc]
+
+    flat_go = go_f.reshape(-1)
+    flat_recv = recv_f.reshape(-1)
+    flat_rank = rank.reshape(-1)
+    free = ~in_valid                                     # [N, IC] post-drain
     free_rank = jnp.cumsum(free, axis=1) - 1
     n_free = jnp.sum(free, axis=1)                       # [N]
     # slot_of_rank[r, k] = inbox slot holding receiver r's k-th free slot.
     slot_of_rank = jnp.full((n, ic), ic, I32).at[
         jnp.arange(n)[:, None], jnp.where(free, free_rank, ic)
     ].set(jnp.broadcast_to(jnp.arange(ic, dtype=I32), (n, ic)), mode="drop")
-    overflow_m = flat_go & (rank_m >= jnp.minimum(n_free, ic)[flat_recv])
+    overflow_m = flat_go & (flat_rank >= jnp.minimum(n_free, ic)[flat_recv])
     place_m = flat_go & ~overflow_m
-    slot_m = slot_of_rank[flat_recv, jnp.clip(rank_m, 0, ic - 1)]
+    slot_m = slot_of_rank[flat_recv, jnp.clip(flat_rank, 0, ic - 1)]
     # Global scatter target over the flattened [N*IC] inbox; N*IC == dropped.
     g = jnp.where(place_m, flat_recv * ic + slot_m, n * ic)
 
-    flat_pay = banks[flat_sender, flat_paysel]           # [M, F]
+    flat_sender = jnp.broadcast_to(sel[None, :, None], (K, A, nc)).reshape(-1)
+    bank_f = bank_k.reshape(KA, 4, F)
+    flat_pay = bank_f[
+        jnp.repeat(jnp.arange(KA), nc), paysel_k.reshape(-1)]  # [KA*nc, F]
 
     in_valid2 = in_valid.reshape(-1).at[g].set(True, mode="drop").reshape(n, ic)
-    in_time2 = st.in_time.reshape(-1).at[g].set(flat_arrive, mode="drop").reshape(n, ic)
-    in_kind2 = st.in_kind.reshape(-1).at[g].set(flat_kind, mode="drop").reshape(n, ic)
-    in_stamp2 = st.in_stamp.reshape(-1).at[g].set(flat_stamp, mode="drop").reshape(n, ic)
-    in_sender2 = st.in_sender.reshape(-1).at[g].set(flat_sender, mode="drop").reshape(n, ic)
-    in_pay2 = st.in_pay.reshape(n * ic, F).at[g].set(flat_pay, mode="drop").reshape(n, ic, F)
-
-    # ---- Timer reschedule per active node (sat_add: see types.sat_add).
-    next_g = sat_add(actions.next_sched, st.startup)
-    timer_time = jnp.where(do_update, jnp.maximum(next_g, t_ev + 1), st.timer_time)
+    in_time2 = st.in_time.reshape(-1).at[g].set(
+        arrive_k.reshape(-1), mode="drop").reshape(n, ic)
+    in_kind2 = st.in_kind.reshape(-1).at[g].set(
+        kind_k.reshape(-1), mode="drop").reshape(n, ic)
+    in_stamp2 = st.in_stamp.reshape(-1).at[g].set(
+        stamp_k.reshape(-1), mode="drop").reshape(n, ic)
+    in_sender2 = st.in_sender.reshape(-1).at[g].set(
+        flat_sender, mode="drop").reshape(n, ic)
+    in_pay2 = st.in_pay.reshape(n * ic, F).at[g].set(
+        flat_pay, mode="drop").reshape(n, ic, F)
 
     delivered = jnp.sum(place_m)
 
     return st.replace(
-        store=s_f, pm=pm_f, node=nx_f, ctx=cx_f,
+        store=store2, pm=pm2, node=nx2, ctx=cx2,
         ho_pay=ho_pay, ho_epoch=ho_epoch,
         in_valid=in_valid2, in_time=in_time2, in_kind=in_kind2,
         in_stamp=in_stamp2, in_sender=in_sender2, in_pay=in_pay2,
@@ -373,10 +509,10 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         clock=jnp.where(live, clock, st.clock),
         node_ctr=node_ctr,
         halted=halt,
-        n_events=st.n_events + jnp.where(live, jnp.sum(active), 0),
+        n_events=st.n_events + jnp.where(live, ev_n, 0),
         n_msgs_sent=st.n_msgs_sent + jnp.where(live, delivered, 0),
-        n_msgs_dropped=st.n_msgs_dropped + jnp.where(live, jnp.sum(dropped), 0),
-        n_inbox_full=st.n_inbox_full + jnp.where(live, jnp.sum(flat_go & overflow_m), 0),
+        n_msgs_dropped=st.n_msgs_dropped + jnp.where(live, drop_n, 0),
+        n_inbox_full=st.n_inbox_full + jnp.where(live, jnp.sum(overflow_m), 0),
     )
 
 
@@ -408,10 +544,11 @@ def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
 def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
                 d_min: int | None = None):
     """``d_min`` overrides the lookahead (must be <= the true minimum message
-    latency).  As long as no inbox overflows, any conservative value yields
-    the SAME trajectories — narrower windows only mean more steps — which
+    latency).  As long as no inbox overflows, any conservative value — and
+    any ``active_lanes``/``drain_k`` choice — yields the SAME trajectories:
+    window shape only decides how much work lands in each step, which
     `tests/test_parallel_sim.py` asserts bit-exactly.  (Under overflow the
-    window width changes which concurrent sends compete for free slots, so
+    window shape changes which concurrent sends compete for free slots, so
     the discarded set — and hence the trajectory — may differ.)  The
     executable is memoized on ``p.structural()`` with the lookahead as a
     runtime scalar, so delay/drop/horizon variants share one compile."""

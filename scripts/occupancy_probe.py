@@ -23,7 +23,9 @@ from librabft_simulator_tpu.sim import simulator as S
 from librabft_simulator_tpu.sim.simulator import dedupe_buffers
 
 
-def probe(engine, name, p, B=512, chunk=32, reps=3):
+def probe(engine, name, p, B=512, chunk=None, reps=None):
+    chunk = chunk or int(os.environ.get("PCHUNK", "32"))
+    reps = reps or int(os.environ.get("PREPS", "3"))
     seeds = np.arange(B, dtype=np.uint32)
     st = dedupe_buffers(engine.init_batch(p, seeds))
     run = engine.make_run_fn(p, chunk)
@@ -40,9 +42,14 @@ def probe(engine, name, p, B=512, chunk=32, reps=3):
     dt = time.perf_counter() - t0
     e1 = int(np.sum(jax.device_get(st.n_events)))
     r1 = int(np.sum(np.max(jax.device_get(st.store.current_round), axis=-1) - 1))
+    lost_f = st.n_queue_full if hasattr(st, "n_queue_full") else st.n_inbox_full
+    lost = int(np.sum(jax.device_get(lost_f)))
+    sent = int(np.sum(jax.device_get(st.n_msgs_sent)))
+    com = int(np.sum(jax.device_get(st.ctx.commit_count)))
     steps = chunk * reps * B
     print(f"{name:10s} ev/s={(e1-e0)/dt:10.0f} rounds/s={(r1-r0)/dt:8.0f} "
-          f"occupancy={(e1-e0)/steps:5.2f} compile={compile_s:5.1f}s dt={dt:.2f}s")
+          f"occupancy={(e1-e0)/steps:5.2f} compile={compile_s:5.1f}s "
+          f"dt={dt:.2f}s ovf={lost/max(lost+sent,1):.3f} commits={com}")
 
 
 def ablate(name):
@@ -92,8 +99,13 @@ if __name__ == "__main__":
     ab = os.environ.get("ABLATE", "")
     engines = os.environ.get("ENGINES", "parallel,serial").split(",")
     ablate(ab)
-    p = SimParams(n_nodes=n, delay_kind="uniform", max_clock=2**30,
-                  queue_cap=max(32, 4 * n))
+    p = SimParams(
+        n_nodes=n, delay_kind=os.environ.get("PDELAY", "uniform"),
+        max_clock=2**30,
+        queue_cap=int(os.environ.get("PQCAP", str(max(32, 4 * n)))),
+        drop_prob=float(os.environ.get("PDROP", "0")),
+        active_lanes=int(os.environ.get("PA", "0")),
+        drain_k=int(os.environ.get("PK", "0")))
     for e in engines:
         probe({"parallel": P, "serial": S}[e], f"{e}{'/' + ab if ab else ''}",
               p, B=B)

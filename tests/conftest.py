@@ -23,15 +23,47 @@ if "xla_force_host_platform_device_count" not in flags:
 # (compile, serialize, or cache-read — observed as wandering segfaults
 # always at the same test count).  Raise the limit when we can (root
 # container); otherwise trim JAX's live-executable count per module below.
+_MAPS_PRIOR = None
 try:
     with open("/proc/sys/vm/max_map_count") as _f:
         _map_count = int(_f.read())
     if _map_count < 1048576:
         with open("/proc/sys/vm/max_map_count", "w") as _f:
             _f.write("1048576")
+        _MAPS_PRIOR = _map_count  # restored in pytest_sessionfinish
     _MAPS_RAISED = True
 except OSError:
     _MAPS_RAISED = False
+
+
+def _other_pytest_running():
+    """True if another live pytest process (not this one) is visible —
+    restoring the sysctl under it would reinstate the mmap segfaults."""
+    me = os.getpid()
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    if b"pytest" in f.read():
+                        return True
+            except OSError:
+                continue
+    except OSError:
+        pass
+    return False
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Undo the container-global sysctl raise once the suite is done
+    (skipped while a concurrent pytest still depends on the raised limit)."""
+    if _MAPS_PRIOR is not None and not _other_pytest_running():
+        try:
+            with open("/proc/sys/vm/max_map_count", "w") as _f:
+                _f.write(str(_MAPS_PRIOR))
+        except OSError:
+            pass
 
 import jax  # noqa: E402
 

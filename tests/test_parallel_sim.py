@@ -28,17 +28,21 @@ from librabft_simulator_tpu.sim.simulator import dedupe_buffers
 g = jax.device_get
 
 
-def small_params(**kw):
+def _small_kw(**kw):
     kw.setdefault("n_nodes", 4)
     kw.setdefault("delay_kind", "uniform")
     kw.setdefault("max_clock", 1500)
     kw.setdefault("window", 8)
     kw.setdefault("chain_k", 2)
     kw.setdefault("commit_log", 16)
-    return SimParams(**kw)
+    return kw
 
 
-def run_parallel(p, seeds, chunk=256, max_chunks=60, d_min=None, **init_kw):
+def small_params(**kw):
+    return SimParams(**_small_kw(**kw))
+
+
+def run_parallel(p, seeds, chunk=256, max_chunks=120, d_min=None, **init_kw):
     if init_kw:
         st = jax.vmap(lambda s: P.init_state(p, s, **init_kw))(
             np.asarray(seeds, np.uint32))
@@ -88,8 +92,27 @@ def test_window_composition_invariance():
     seeds = np.arange(4, dtype=np.uint32)
     assert P.d_min_of(p) > 1, "uniform table should have min latency > 1"
     st_wide = run_parallel(p, seeds)
-    st_narrow = run_parallel(p, seeds, d_min=1, max_chunks=120)
+    st_narrow = run_parallel(p, seeds, d_min=1, max_chunks=240)
     assert_same_state(st_wide, st_narrow)
+
+
+def test_lane_drain_composition_invariance():
+    """Lane count and drain depth only reshape windows: A=1/K=1 (strictly
+    serial schedule), A=2/K=3, and narrow-lookahead hybrids must all be
+    bit-identical to the auto shape.  This is the regression test for the
+    per-node-horizon unsoundness (two-hop feedback: a node's own in-window
+    send can cause a reply that lands before its wider per-node horizon)."""
+    p = small_params()
+    seeds = np.arange(4, dtype=np.uint32)
+    ref = run_parallel(p, seeds)
+    for kw, dm in [
+        (dict(active_lanes=1, drain_k=1), None),
+        (dict(active_lanes=2, drain_k=3), None),
+        (dict(active_lanes=1, drain_k=2), 1),
+    ]:
+        st = run_parallel(SimParams(**{**_small_kw(), **kw}), seeds, d_min=dm,
+                          max_chunks=400)
+        assert_same_state(ref, st)
 
 
 def test_statistical_agreement_with_serial():
