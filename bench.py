@@ -64,10 +64,14 @@ def _tunnel_listening() -> bool:
 
     # Known relay ports of the loopback tunnel; overridable if the relay
     # moves (a wrong list would demote a healthy TPU run to CPU).
-    ports = tuple(
-        int(x) for x in
-        os.environ.get("BENCH_TUNNEL_PORTS", "8082,8083,8087").split(",")
-        if x.strip()) or (8082, 8083, 8087)
+    try:
+        ports = tuple(
+            int(x) for x in
+            os.environ.get("BENCH_TUNNEL_PORTS", "8082,8083,8087").split(",")
+            if x.strip()) or (8082, 8083, 8087)
+    except ValueError:
+        print("bench: ignoring malformed BENCH_TUNNEL_PORTS", file=sys.stderr)
+        ports = (8082, 8083, 8087)
     for port in ports:
         try:
             with socket.create_connection(("127.0.0.1", port), timeout=5.0):
@@ -230,7 +234,7 @@ def _time_engine(engine, p, batch, chunk, reps, init_kw=None):
         assert max_epoch == 0, (
             f"bench crossed an epoch boundary (max epoch {max_epoch}) with "
             "epoch_handoff=False; re-bench with the default handoff config")
-    return {
+    res = {
         "max_epoch": max_epoch,
         "rounds_per_sec": (r1 - r0) / dt,
         "commits_per_sec": (c1 - c0) / dt,
@@ -239,6 +243,15 @@ def _time_engine(engine, p, batch, chunk, reps, init_kw=None):
         "compile_s": compile_s,
         "overflow_frac": round(lost / max(sent + lost, 1), 4),
     }
+    if not hasattr(st, "n_queue_full"):
+        # Parallel engine: window occupancy = events processed per
+        # instance-window (ceiling = lanes * drain per window).
+        from librabft_simulator_tpu.sim.parallel_sim import drain_of, lanes_of
+
+        res["window_occupancy"] = round(
+            (e1 - e0) / max(chunk * reps * batch, 1), 2)
+        res["occupancy_ceiling"] = lanes_of(p) * drain_of(p)
+    return res
 
 
 def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
@@ -342,8 +355,7 @@ def sweep_configs(scale: float = 1.0):
                                      delay_kind="uniform")),
         ("3_64node_1k_pareto_drop", dict(n_nodes=64, batch=b(1000),
                                          engine_name="parallel",
-                                         delay_kind="pareto", drop=0.05,
-                                         inbox_cap=48)),
+                                         delay_kind="pareto", drop=0.05)),
         ("4_byz_f1_10k", dict(n_nodes=4, batch=b(10000),
                               engine_name="serial", delay_kind="uniform",
                               init_kw=dict(byz_equivocate=eq4))),
@@ -361,8 +373,15 @@ def run_sweep(out_path: str) -> None:
     scale = float(os.environ.get("BENCH_SWEEP_SCALE", 1.0 if on_tpu else 0.1))
     chunk = int(os.environ.get("BENCH_STEPS", 64 if on_tpu else 16))
     reps = int(os.environ.get("BENCH_REPS", 2))
+    try:
+        only = int(os.environ.get("BENCH_SWEEP_ONLY", "0"))  # 1-based index
+    except ValueError:
+        print("bench: ignoring malformed BENCH_SWEEP_ONLY", file=sys.stderr)
+        only = 0
     rows = []
-    for name, kw in sweep_configs(scale):
+    for idx, (name, kw) in enumerate(sweep_configs(scale), start=1):
+        if only and idx != only:
+            continue
         try:
             r = run_bench(chunk=chunk, reps=reps, **kw)
             r["config"] = name

@@ -195,7 +195,18 @@ def handle_request(p: SimParams, s: Store, author, req: Payload,
 
 def handle_response(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights,
                     pay: Payload):
-    """data_sync.rs:209-241 + state-sync jump.  Returns (store, nx, ctx)."""
+    """data_sync.rs:209-241 + state-sync jump.  Returns (store, nx, ctx).
+
+    Known fidelity boundary of the K-tail design: a response whose chain
+    base does not connect to the receiver's store (intra-epoch round gap
+    wider than ``chain_k``) and whose hqc round is NOT beyond the
+    ``window - chain_k`` jump threshold is simply absorbed without effect —
+    the receiver re-requests until either the gap closes or the gap grows
+    jump-worthy.  The reference cannot hit this (it ships the exact
+    ``unknown_records`` delta, record_store.rs:801-831).  Size ``chain_k``
+    to cover an epoch's typical round count when relying on the cross-epoch
+    handoff ring (tests/test_epoch_handoff.py::
+    test_multi_epoch_laggard_recovers_via_ring)."""
     # Decide whether normal chain replay can possibly connect.
     gap_jump = pay.hqc.valid & (
         (pay.epoch > s.epoch_id)

@@ -84,9 +84,15 @@ class SimParams:
     gamma: float = 2.0
     lam: float = 0.5          # lambda; fixed-point applied as (lam_fp * d) >> 16
     commit_chain: int = 3     # 3 = LibraBFTv2 3-chain; 2 = HotStuff-style 2-chain
-    epoch_handoff: bool = True  # serve one-epoch-behind requesters the
-                                # previous epoch's K-tail (data_sync.rs:82-92,
+    epoch_handoff: bool = True  # serve laggard requesters a held previous
+                                # epoch's K-tail (data_sync.rs:82-92,
                                 # node.rs record_store_at); off = laggards jump
+    handoff_epochs: int = 2     # E: ring of previous-epoch packs kept per
+                                # node ([N, E, F]); any requester whose epoch
+                                # matches a held pack is served (the reference
+                                # keeps ALL previous epochs' stores —
+                                # node.rs record_store_at — this keeps E
+                                # bounded packs)
     # Event selection backend for the serial engine: "xla" (default, fused
     # masked reductions), "pallas" (ops/pallas_queue.py TPU kernel), or
     # "pallas_interpret" (same kernel, interpreter mode — CPU testable).
@@ -112,6 +118,13 @@ class SimParams:
     max_clock: int = 1000
     dur_table_size: int = 64
     trace_cap: int = 0        # round-switch trace entries (0 = tracing off)
+
+    def __post_init__(self):
+        if self.epoch_handoff and self.handoff_epochs < 1:
+            raise ValueError(
+                "handoff_epochs must be >= 1 when epoch_handoff is on "
+                f"(got {self.handoff_epochs}); the three engines would "
+                "otherwise diverge on a zero-width ring")
 
     @property
     def lam_fp(self) -> int:

@@ -120,7 +120,9 @@ def run(p: SimParams, seed: int, weights=None, byz_equivocate=None,
         p.n_nodes, p.window, p.queue_cap, p.chain_k, p.commit_log,
         p.commands_per_epoch, p.target_commit_interval, p.lam_fp,
         p.commit_chain, p.max_clock, p.dur_table_size,
-        int(p.shuffle_receivers), int(p.epoch_handoff),
+        int(p.shuffle_receivers),
+        # epoch_handoff carries the ring depth E (0 = handoff off).
+        p.handoff_epochs if p.epoch_handoff else 0,
         ctypes.c_uint32(p.drop_u32), ctypes.c_uint32(seed & 0xFFFFFFFF),
         ctypes.c_longlong(max_events),
         delay, dur, w, eq, silent, glob, node, log,

@@ -432,9 +432,11 @@ class OracleSim:
         ]
         self.timer_time = list(self.startup)
         self.timer_stamp = list(range(n))
-        # Cross-epoch handoff packs (mirrors SimState.ho_pay / ho_epoch).
-        self.ho_pay: List = [None] * n
-        self.ho_epoch = [-1] * n
+        # Cross-epoch handoff ring (mirrors SimState.ho_pay / ho_epoch:
+        # [N, E] packs, slot = epoch % handoff_epochs).
+        E_ho = p.handoff_epochs
+        self.ho_pay: List = [[None] * E_ho for _ in range(n)]
+        self.ho_epoch = [[-1] * E_ho for _ in range(n)]
         self.n_handoff_served = 0  # oracle-only diagnostic
         self.clock = 0
         self.stamp_ctr = n
@@ -580,16 +582,19 @@ class OracleSim:
             # The tensor path builds the response from the (forged) notif.
             response.hqc = copy.deepcopy(notif.hqc)
 
-        # Cross-epoch handoff (mirrors sim/simulator.py): capture the pack
-        # update_node built from the post-update, pre-switch store; serve it
-        # to requesters still in that epoch.
+        # Cross-epoch handoff ring (mirrors sim/simulator.py): capture the
+        # pack update_node built from the post-update, pre-switch store;
+        # serve any requester whose epoch matches a held pack.
         if p.epoch_handoff:
+            E_ho = p.handoff_epochs
             if do_update and actions.ho_switched:
-                self.ho_pay[a] = copy.deepcopy(actions.ho_pack)
-                self.ho_epoch[a] = actions.ho_epoch
-            if (is_request and pay_in.epoch == self.ho_epoch[a]
+                wslot = max(actions.ho_epoch, 0) % E_ho
+                self.ho_pay[a][wslot] = copy.deepcopy(actions.ho_pack)
+                self.ho_epoch[a][wslot] = actions.ho_epoch
+            rslot = max(pay_in.epoch, 0) % E_ho
+            if (is_request and pay_in.epoch == self.ho_epoch[a][rslot]
                     and pay_in.epoch < s.epoch_id):
-                response = copy.deepcopy(self.ho_pay[a])
+                response = copy.deepcopy(self.ho_pay[a][rslot])
                 self.n_handoff_served += 1
 
         want = ([cand0_want] + [send_mask[i] for i in recv_order]

@@ -72,6 +72,14 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]
         if arr.shape != leaf.shape:
+            if key.split("/")[-1] in ("ho_pay", "ho_epoch"):
+                # Pre-ring checkpoints hold a single [N, F] pack per node;
+                # the handoff cache is soft state, so restore it empty
+                # rather than failing the whole load.
+                leaves.append(
+                    np.full(leaf.shape, -1 if key.endswith("ho_epoch") else 0,
+                            leaf.dtype))
+                continue
             raise ValueError(f"{key}: shape {arr.shape} != {leaf.shape}")
         leaves.append(arr.astype(leaf.dtype))
     return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(like), leaves)

@@ -214,8 +214,10 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         byz_forge_qc=jnp.asarray(byz_forge_qc, jnp.bool_),
         max_clock=_i32(p.max_clock),
         drop_u32=jnp.uint32(p.drop_u32),
-        ho_pay=jnp.zeros((n, F if p.epoch_handoff else 0), I32),
-        ho_epoch=jnp.full((n,), -1, I32),
+        ho_pay=jnp.zeros(
+            (n, p.handoff_epochs if p.epoch_handoff else 0, F), I32),
+        ho_epoch=jnp.full(
+            (n, p.handoff_epochs if p.epoch_handoff else 0), -1, I32),
         clock=_i32(0),
         node_ctr=jnp.ones((n,), I32),
         halted=jnp.bool_(False),
@@ -336,15 +338,21 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
             response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
             resp_packed = pack_payload(response)
             if p.epoch_handoff:
-                # Cross-epoch handoff (mirrors sim/simulator.py): capture the
-                # pack update_node built from the post-update, pre-switch
-                # store; serve it to requesters still in that epoch.
+                # Cross-epoch handoff ring (mirrors sim/simulator.py):
+                # capture the pack update_node built from the post-update,
+                # pre-switch store; serve any requester whose epoch matches
+                # a held pack.
+                E = p.handoff_epochs
                 switched = do_update[i] & actions.ho_switched
-                ho_row = jnp.where(switched, actions.ho_pack, ho_row)
-                ho_ep = jnp.where(switched, actions.ho_epoch, ho_ep)
-                serve_ho = (is_request[i] & (pay_in.epoch == ho_ep)
+                wslot = jnp.remainder(jnp.maximum(actions.ho_epoch, 0), E)
+                ho_row = store_ops._sel(
+                    switched, ho_row.at[wslot].set(actions.ho_pack), ho_row)
+                ho_ep = store_ops._sel(
+                    switched, ho_ep.at[wslot].set(actions.ho_epoch), ho_ep)
+                rslot = jnp.remainder(jnp.maximum(pay_in.epoch, 0), E)
+                serve_ho = (is_request[i] & (ho_ep[rslot] == pay_in.epoch)
                             & (pay_in.epoch < s_f.epoch_id))
-                resp_row = jnp.where(serve_ho, ho_row, resp_packed)
+                resp_row = jnp.where(serve_ho, ho_row[rslot], resp_packed)
             else:
                 resp_row = resp_packed
             bank = jnp.stack([

@@ -92,8 +92,11 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         node=NodeExtra.initial((n,)),
         ctx=Context.initial(p, (n,)),
         queue=Queue.initial(p),
-        ho_pay=jnp.zeros((n, payload_width(p) if p.epoch_handoff else 0), I32),
-        ho_epoch=jnp.full((n,), -1, I32),
+        ho_pay=jnp.zeros(
+            (n, p.handoff_epochs if p.epoch_handoff else 0, payload_width(p)),
+            I32),
+        ho_epoch=jnp.full(
+            (n, p.handoff_epochs if p.epoch_handoff else 0), -1, I32),
         timer_time=startup.astype(I32),
         timer_stamp=jnp.arange(n, dtype=I32),
         startup=startup.astype(I32),
@@ -245,20 +248,27 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
     resp_packed = pack_payload(response)
     if p.epoch_handoff:
-        # Cross-epoch handoff (reference keeps previous epochs' stores:
-        # node.rs record_store_at, data_sync.rs:82-92; here one bounded
-        # packed response per node): update_node captured the old-epoch pack
-        # at the switch (post-update, pre-switch store — the commit-enabling
-        # QC is often minted in the same update); serve it to a requester
-        # still in that epoch.
+        # Cross-epoch handoff (reference keeps ALL previous epochs' stores:
+        # node.rs record_store_at, data_sync.rs:82-92; here a ring of E
+        # bounded packed responses per node): update_node captured the
+        # old-epoch pack at the switch (post-update, pre-switch store — the
+        # commit-enabling QC is often minted in the same update); serve any
+        # requester whose epoch matches a held pack.
+        E = p.handoff_epochs
         switched = do_update & actions.ho_switched
-        ho_row = jnp.where(switched, actions.ho_pack, st.ho_pay[a])
-        ho_epoch_v = jnp.where(switched, actions.ho_epoch, st.ho_epoch[a])
-        ho_pay = st.ho_pay.at[a].set(ho_row)
-        ho_epoch = st.ho_epoch.at[a].set(ho_epoch_v)
-        serve_ho = (is_request & (pay_in.epoch == ho_epoch_v)
+        wslot = jnp.remainder(jnp.maximum(actions.ho_epoch, 0), E)
+        rows_a = st.ho_pay[a]       # [E, F]
+        eps_a = st.ho_epoch[a]      # [E]
+        rows_a = store_ops._sel(switched, rows_a.at[wslot].set(actions.ho_pack),
+                                rows_a)
+        eps_a = store_ops._sel(switched, eps_a.at[wslot].set(actions.ho_epoch),
+                               eps_a)
+        ho_pay = st.ho_pay.at[a].set(rows_a)
+        ho_epoch = st.ho_epoch.at[a].set(eps_a)
+        rslot = jnp.remainder(jnp.maximum(pay_in.epoch, 0), E)
+        serve_ho = (is_request & (eps_a[rslot] == pay_in.epoch)
                     & (pay_in.epoch < s_f.epoch_id))
-        resp_row = jnp.where(serve_ho, ho_row, resp_packed)
+        resp_row = jnp.where(serve_ho, rows_a[rslot], resp_packed)
     else:
         ho_pay, ho_epoch = st.ho_pay, st.ho_epoch
         resp_row = resp_packed

@@ -63,7 +63,7 @@ struct Params {
   int commands_per_epoch, target_commit_interval, delta;
   int lam_fp, commit_chain, max_clock, dur_table_size;
   int shuffle_receivers = 0;
-  int epoch_handoff = 1;
+  int epoch_handoff = 2;  // ring depth E of held previous-epoch packs; 0=off
   u32 drop_u32;
   // tables appended by caller
 };
@@ -649,9 +649,10 @@ struct Engine {
     Payload pay;
   };
   std::vector<Msg> queue;
-  // Cross-epoch handoff packs (mirrors SimState.ho_pay / ho_epoch).
-  std::vector<Payload> ho_pay;
-  std::vector<int> ho_epoch;
+  // Cross-epoch handoff ring (mirrors SimState.ho_pay / ho_epoch:
+  // [N][E] packs, slot = epoch % E where E = p.epoch_handoff).
+  std::vector<std::vector<Payload>> ho_pay;
+  std::vector<std::vector<int>> ho_epoch;
   std::vector<int> startup, timer_time, timer_stamp;
   int clock = 0, stamp_ctr = 0;
   bool halted = false;
@@ -673,8 +674,9 @@ struct Engine {
       ctxs.emplace_back(p.commit_log);
     }
     queue.assign(p.queue_cap, Msg{false, 0, 0, 0, 0, 0, Payload(n, p.chain_k)});
-    ho_pay.assign(n, Payload(n, p.chain_k));
-    ho_epoch.assign(n, -1);
+    int E_ho = p.epoch_handoff > 0 ? p.epoch_handoff : 0;
+    ho_pay.assign(n, std::vector<Payload>(E_ho, Payload(n, p.chain_k)));
+    ho_epoch.assign(n, std::vector<int>(E_ho, -1));
     for (int c = 0; c < n; c++) {
       int d = delay_table[rng_u32(seed, (u32)c) >> (32 - TABLE_BITS)] + 1;
       startup.push_back(d);
@@ -1087,14 +1089,17 @@ struct Engine {
     // Cross-epoch handoff (mirrors sim/simulator.py): capture the pack
     // update_node built from the post-update, pre-switch store; serve it to
     // requesters still in that epoch.
-    if (p.epoch_handoff) {
+    if (p.epoch_handoff > 0) {
+      int E_ho = p.epoch_handoff;
       if (do_update && actions.ho_switched) {
-        ho_pay[a] = actions.ho_pack;
-        ho_epoch[a] = actions.ho_epoch_old;
+        int wslot = std::max(actions.ho_epoch_old, 0) % E_ho;
+        ho_pay[a][wslot] = actions.ho_pack;
+        ho_epoch[a][wslot] = actions.ho_epoch_old;
       }
-      if (is_request && pay_in.epoch == ho_epoch[a] &&
+      int rslot = std::max(pay_in.epoch, 0) % E_ho;
+      if (is_request && pay_in.epoch == ho_epoch[a][rslot] &&
           pay_in.epoch < s.epoch_id)
-        response = ho_pay[a];
+        response = ho_pay[a][rslot];
     }
 
     bool silent = byz_silent[a];

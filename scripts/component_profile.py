@@ -1,0 +1,142 @@
+"""Component-level wall-time attribution for the serial step.
+
+Times each piece of the per-event machinery as its own jitted, vmapped
+executable over a [B] batch of node slices taken from a warmed-up fleet —
+identical inputs per component, no trajectory feedback, so the numbers are
+directly comparable (unlike the ABLATE= stubs, which perturb trajectories).
+
+Run: JAX_PLATFORMS=cpu python scripts/component_profile.py
+"""
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+
+from librabft_simulator_tpu.core import data_sync, node as node_ops
+from librabft_simulator_tpu.core.types import (
+    Payload, SimParams, pack_payload, unpack_payload)
+from librabft_simulator_tpu.sim import simulator as S
+
+
+def main():
+    n = int(os.environ.get("PN", "4"))
+    B = int(os.environ.get("PB", "2048"))
+    reps = int(os.environ.get("PREPS", "20"))
+    p = SimParams(n_nodes=n, delay_kind="uniform", max_clock=2**30,
+                  queue_cap=max(32, 4 * n),
+                  epoch_handoff=os.environ.get("PHO", "0") == "1")
+    seeds = np.arange(B, dtype=np.uint32)
+    st = S.init_batch(p, seeds)
+    st = S.dedupe_buffers(st)
+    run = S.make_run_fn(p, 512)
+    st = run(st)  # steady state
+    jax.block_until_ready(st)
+
+    # One node slice per instance (node 0) + a round-robin incoming payload
+    # (re-broadcast each instance's own queue slot 0 payload).
+    a = jnp.zeros((B,), jnp.int32)
+    s_a = jax.tree.map(lambda x: x[:, 0], st.store)
+    pm_a = jax.tree.map(lambda x: x[:, 0], st.pm)
+    nx_a = jax.tree.map(lambda x: x[:, 0], st.node)
+    cx_a = jax.tree.map(lambda x: x[:, 0], st.ctx)
+    pay_rows = st.queue.payload[:, 0]
+    weights = st.weights
+    clock = st.clock
+    dur = jnp.asarray(p.duration_table())
+
+    def timed(name, fn, *args):
+        f = jax.jit(fn)
+        out = f(*args)
+        jax.block_until_ready(out)  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name:28s} {dt*1e3:9.2f} ms/call  ({dt/B*1e6:7.2f} us/event)")
+        return dt
+
+    def step_full(st):
+        return S.step(p, jnp.asarray(p.delay_table()), dur, st)
+
+    timed("FULL step", jax.vmap(step_full), st)
+
+    unpack = lambda rows: jax.vmap(lambda r: unpack_payload(p, r))(rows)  # noqa
+    pay = unpack(pay_rows)
+
+    timed("unpack_payload", unpack, pay_rows)
+    timed("handle_notification",
+          jax.vmap(lambda s, w, q: data_sync.handle_notification(p, s, w, q)),
+          s_a, weights, pay)
+    timed("handle_response",
+          jax.vmap(lambda s, nx, cx, w, q: data_sync.handle_response(
+              p, s, nx, cx, w, q)), s_a, nx_a, cx_a, weights, pay)
+    timed("update_node",
+          jax.vmap(lambda s, pm, nx, cx, w, aa, c: node_ops.update_node(
+              p, s, pm, nx, cx, w, aa, c, dur)),
+          s_a, pm_a, nx_a, cx_a, weights, a, clock)
+    timed("create_notification",
+          jax.vmap(lambda s, aa: data_sync.create_notification(p, s, aa)),
+          s_a, a)
+    timed("handle_request(resp build)",
+          jax.vmap(lambda s, aa, q: data_sync.handle_request(p, s, aa, q)),
+          s_a, a, pay)
+    timed("create_request",
+          jax.vmap(lambda s: data_sync.create_request(p, s)), s_a)
+    timed("pack_payload x4",
+          jax.vmap(lambda q: jnp.stack([pack_payload(q)] * 4)), pay)
+    timed("timeout_batch x2",
+          jax.vmap(lambda s, w, q: data_sync._insert_timeout_batch(
+              p, data_sync._insert_timeout_batch(p, s, w, q.tc_to, q.epoch),
+              w, q.cur_to, q.epoch)), s_a, weights, pay)
+
+    def slice_roundtrip(st):
+        aa = st.clock % p.n_nodes  # data-dependent index like the real step
+        parts = (st.store, st.pm, st.node, st.ctx)
+        sl = [S._node_slice(x, aa) for x in parts]
+        upd = [S._node_update(x, aa, v) for x, v in zip(parts, sl)]
+        return st.replace(store=upd[0], pm=upd[1], node=upd[2], ctx=upd[3])
+
+    timed("node slice+update (4 structs)", jax.vmap(slice_roundtrip), st)
+    timed("_select_event",
+          jax.vmap(lambda s: S._select_event(p, s)), st)
+
+    def queue_scatter(st):
+        q = st.queue
+        tgt = jnp.arange(2 * p.n_nodes + 1, dtype=jnp.int32) % p.queue_cap
+        rows = jnp.broadcast_to(q.payload[0], (2 * p.n_nodes + 1,
+                                               q.payload.shape[1]))
+        return q.replace(
+            valid=q.valid.at[tgt].set(True),
+            time=q.time.at[tgt].set(1), kind=q.kind.at[tgt].set(1),
+            stamp=q.stamp.at[tgt].set(1), sender=q.sender.at[tgt].set(1),
+            receiver=q.receiver.at[tgt].set(1),
+            payload=q.payload.at[tgt].set(rows))
+
+    timed("queue scatter block", jax.vmap(queue_scatter), st)
+
+    from librabft_simulator_tpu.core import store as store_ops
+    timed("insert_qc x2",
+          jax.vmap(lambda s, w, q: store_ops.insert_qc(
+              p, store_ops.insert_qc(p, s, w, q.hcc)[0], w, q.hqc)),
+          s_a, weights, pay)
+    timed("insert_block+vote",
+          jax.vmap(lambda s, w, q: store_ops.insert_vote(
+              p, store_ops.insert_block(p, s, w, q.prop_blk, q.epoch)[0],
+              w, q.vote)), s_a, weights, pay)
+
+
+if __name__ == "__main__":
+    main()

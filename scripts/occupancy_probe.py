@@ -58,7 +58,9 @@ def ablate(name):
     from librabft_simulator_tpu.core import data_sync as ds
     from librabft_simulator_tpu.core import node as node_ops
 
-    if name == "response":
+    if name == "timeouts":
+        ds._insert_timeout_batch = lambda p, s, w, to_msg, rec_epoch: s
+    elif name == "response":
         ds.handle_response = lambda p, s, nx, cx, w, pay: (s, nx, cx)
     elif name == "notification":
         import jax.numpy as jnp

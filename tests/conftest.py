@@ -36,20 +36,24 @@ except OSError:
     _MAPS_RAISED = False
 
 
-def _other_pytest_running():
-    """True if another live pytest process (not this one) is visible —
+def _other_jax_job_running():
+    """True if another live process that depends on the raised map count is
+    visible (pytest, bench, warm_cache, probes, any librabft tooling) —
     restoring the sysctl under it would reinstate the mmap segfaults."""
     me = os.getpid()
+    needles = (b"pytest", b"bench.py", b"warm_cache", b"occupancy_probe",
+               b"component_profile", b"librabft")
     try:
         for pid in os.listdir("/proc"):
             if not pid.isdigit() or int(pid) == me:
                 continue
             try:
                 with open(f"/proc/{pid}/cmdline", "rb") as f:
-                    if b"pytest" in f.read():
-                        return True
+                    cmd = f.read()
             except OSError:
                 continue
+            if any(n in cmd for n in needles):
+                return True
     except OSError:
         pass
     return False
@@ -58,7 +62,7 @@ def _other_pytest_running():
 def pytest_sessionfinish(session, exitstatus):
     """Undo the container-global sysctl raise once the suite is done
     (skipped while a concurrent pytest still depends on the raised limit)."""
-    if _MAPS_PRIOR is not None and not _other_pytest_running():
+    if _MAPS_PRIOR is not None and not _other_jax_job_running():
         try:
             with open("/proc/sys/vm/max_map_count", "w") as _f:
                 _f.write(str(_MAPS_PRIOR))
