@@ -31,6 +31,14 @@ def _flatten_with_paths(state):
     return out, treedef
 
 
+def _ho_default(field: str, leaf) -> np.ndarray:
+    """Fresh-init value of a cross-epoch handoff leaf (soft cache state):
+    zero packs, -1 ('no epoch held') slots.  Single source for both the
+    missing-key and pre-ring shape-mismatch restore paths."""
+    fill = -1 if field == "ho_epoch" else 0
+    return np.full(leaf.shape, fill, leaf.dtype)
+
+
 def save(path: str, state: SimState) -> None:
     arrays, _ = _flatten_with_paths(state)
     np.savez_compressed(path, **arrays)
@@ -56,29 +64,24 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
         key = "/".join(
             getattr(pp, "name", None) or str(getattr(pp, "idx", pp)) for pp in path
         )
+        field = key.split("/")[-1]
         if key not in data:
             # Forward compatibility for KNOWN later-added fields only (round
             # 4's cross-epoch handoff state): synthesize the fresh-init
             # default explicitly — ``like`` may be mid-run, and copying its
             # leaf would inject stale handoff state into the restore.
             # Anything else missing is a corrupt/foreign checkpoint.
-            field = key.split("/")[-1]
-            if field == "ho_pay":
-                leaves.append(np.zeros(leaf.shape, leaf.dtype))
-                continue
-            if field == "ho_epoch":
-                leaves.append(np.full(leaf.shape, -1, leaf.dtype))
+            if field in ("ho_pay", "ho_epoch"):
+                leaves.append(_ho_default(field, leaf))
                 continue
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]
         if arr.shape != leaf.shape:
-            if key.split("/")[-1] in ("ho_pay", "ho_epoch"):
+            if field in ("ho_pay", "ho_epoch"):
                 # Pre-ring checkpoints hold a single [N, F] pack per node;
                 # the handoff cache is soft state, so restore it empty
                 # rather than failing the whole load.
-                leaves.append(
-                    np.full(leaf.shape, -1 if key.endswith("ho_epoch") else 0,
-                            leaf.dtype))
+                leaves.append(_ho_default(field, leaf))
                 continue
             raise ValueError(f"{key}: shape {arr.shape} != {leaf.shape}")
         leaves.append(arr.astype(leaf.dtype))

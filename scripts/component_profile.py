@@ -95,8 +95,14 @@ def main():
           s_a, a, pay)
     timed("create_request",
           jax.vmap(lambda s: data_sync.create_request(p, s)), s_a)
-    timed("pack_payload x4",
-          jax.vmap(lambda q: jnp.stack([pack_payload(q)] * 4)), pay)
+    def pack4(q):
+        # Four DISTINCT payloads (perturb one field per copy) — a stack of
+        # one traced pack would fold into a single computation and
+        # under-attribute packing ~4x.
+        return jnp.stack([
+            pack_payload(q.replace(epoch=q.epoch + i)) for i in range(4)])
+
+    timed("pack_payload x4", jax.vmap(pack4), pay)
     timed("timeout_batch x2",
           jax.vmap(lambda s, w, q: data_sync._insert_timeout_batch(
               p, data_sync._insert_timeout_batch(p, s, w, q.tc_to, q.epoch),
