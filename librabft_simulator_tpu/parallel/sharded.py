@@ -29,11 +29,14 @@ from ..sim import simulator as sim_ops
 from . import mesh as mesh_ops
 
 
-def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int):
-    """jit-compiled scan of ``num_steps`` events, batch dim sharded over the
-    mesh.  Input/output shardings are pinned so the compiled program is pure
-    SPMD with no resharding."""
-    run = sim_ops.make_run_fn(p, num_steps, batched=True)  # jitted vmapped scan
+def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
+                        engine=None):
+    """jit-compiled scan of ``num_steps`` events (serial engine) or windows
+    (``engine=sim.parallel_sim``), batch dim sharded over the mesh.
+    Input/output shardings are pinned so the compiled program is pure SPMD
+    with no resharding — both engines are collective-free over dp."""
+    eng = engine if engine is not None else sim_ops
+    run = eng.make_run_fn(p, num_steps, batched=True)  # jitted vmapped scan
     sh = mesh_ops.batch_sharding(mesh)
 
     def sharded(st):
@@ -43,11 +46,12 @@ def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int, chunk: int = 256):
+def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
+                chunk: int = 256, engine=None):
     """Host loop over sharded chunks until all instances halt."""
     import numpy as np
 
-    run = make_sharded_run_fn(p, mesh, chunk)
+    run = make_sharded_run_fn(p, mesh, chunk, engine=engine)
     state = mesh_ops.shard_batch(mesh, sim_ops.dedupe_buffers(state))
     done_steps = 0
     while done_steps < num_steps:

@@ -21,23 +21,28 @@ import subprocess
 import sys
 
 SHAPES = [
-    # (engine, SimParams kwargs, batch) — representative heavy shapes from
-    # the suite.  Batch size is part of the compiled shape: batch=None means
-    # an UNBATCHED single-instance run (how the parity tests drive the
-    # serial engine); the parallel entries mirror tests/test_parallel_sim.py
-    # small_params batches and tests/test_epoch_handoff.py boundary_params.
-    ("serial", {}, None),                                 # parity default
-    ("serial", {"n_nodes": 4}, None),
-    ("serial", {"n_nodes": 3, "commands_per_epoch": 6}, None),  # handoff
+    # (engine, SimParams kwargs, batch, chunk) — representative heavy shapes
+    # from the suite.  Batch size AND scan length are part of the compiled
+    # shape: batch=None means an UNBATCHED single-instance run (how the
+    # parity tests drive the serial engine); the parallel entries mirror
+    # tests/test_parallel_sim.py small_params batches (chunk 256) and
+    # tests/test_epoch_handoff.py boundary_params; the last entry matches
+    # test_multichip's sharded-parallel chunk=64.
+    ("serial", {}, None, 256),                            # parity default
+    ("serial", {"n_nodes": 4}, None, 256),
+    ("serial", {"n_nodes": 3, "commands_per_epoch": 6}, None, 256),  # handoff
     ("parallel",
      {"n_nodes": 4, "delay_kind": "uniform", "window": 8, "chain_k": 2,
-      "commit_log": 16}, 6),
+      "commit_log": 16}, 6, 256),
     ("parallel",
      {"n_nodes": 4, "delay_kind": "uniform", "window": 8, "chain_k": 2,
-      "commit_log": 16}, 8),
+      "commit_log": 16}, 8, 256),
     ("parallel",
      {"n_nodes": 3, "commands_per_epoch": 6, "delay_kind": "uniform",
-      "drop_prob": 0.1, "window": 16, "chain_k": 4}, 8),
+      "drop_prob": 0.1, "window": 16, "chain_k": 4}, 8, 256),
+    ("parallel",
+     {"n_nodes": 4, "delay_kind": "uniform", "window": 8, "chain_k": 2,
+      "commit_log": 16}, 16, 64),  # test_multichip sharded-parallel shape
 ]
 
 CHILD = r"""
@@ -58,15 +63,15 @@ from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.sim import parallel_sim, simulator
 from librabft_simulator_tpu.sim.simulator import dedupe_buffers
 
-engine_name, kw, batch = json.loads(sys.argv[1])
+engine_name, kw, batch, chunk = json.loads(sys.argv[1])
 engine = parallel_sim if engine_name == "parallel" else simulator
 p = SimParams(max_clock=500, **kw)
 if batch is None:
     st = dedupe_buffers(engine.init_state(p, 0))
-    run = engine.make_run_fn(p, 256, batched=False)
+    run = engine.make_run_fn(p, chunk, batched=False)
 else:
     st = dedupe_buffers(engine.init_batch(p, np.arange(batch, dtype=np.uint32)))
-    run = engine.make_run_fn(p, 256)
+    run = engine.make_run_fn(p, chunk)
 jax.block_until_ready(run(st))
 print("warmed", engine_name, kw, batch)
 """
@@ -101,20 +106,21 @@ def warm_bench(root: str) -> None:
 def main():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if "--list" in sys.argv:
-        for e, kw, b in SHAPES:
-            print(e, kw, b)
+        for e, kw, b, c in SHAPES:
+            print(e, kw, b, c)
         return
     if "--bench" in sys.argv:
         warm_bench(root)
         return
     import json
 
-    for e, kw, b in SHAPES:
+    for e, kw, b, c in SHAPES:
         r = subprocess.run(
             [sys.executable, "-c", CHILD % {"root": root},
-             json.dumps([e, kw, b])],
+             json.dumps([e, kw, b, c])],
             cwd=root)
-        print(f"[warm_cache] {e} {kw} b={b}: rc={r.returncode}", flush=True)
+        print(f"[warm_cache] {e} {kw} b={b} chunk={c}: rc={r.returncode}",
+              flush=True)
 
 
 if __name__ == "__main__":
