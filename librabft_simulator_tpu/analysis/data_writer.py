@@ -78,7 +78,11 @@ class DataWriter:
             "n_events": int(sel(g(st.n_events))),
             "n_msgs_sent": n_msgs,
             "n_msgs_dropped": int(sel(g(st.n_msgs_dropped))),
-            "n_queue_full": int(sel(g(st.n_queue_full))),
+            # Serial engine counts shared-queue overflow; the parallel
+            # engine counts per-receiver inbox overflow.
+            "n_queue_full": int(sel(g(
+                st.n_queue_full if hasattr(st, "n_queue_full")
+                else st.n_inbox_full))),
             "commit_count": g(st.ctx.commit_count)[instance].tolist()
             if instance is not None else g(st.ctx.commit_count).tolist(),
             "sync_jumps": g(st.ctx.sync_jumps)[instance].tolist()

@@ -66,13 +66,18 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
         )
         field = key.split("/")[-1]
         if key not in data:
-            # Forward compatibility for KNOWN later-added fields only (round
-            # 4's cross-epoch handoff state): synthesize the fresh-init
-            # default explicitly — ``like`` may be mid-run, and copying its
-            # leaf would inject stale handoff state into the restore.
-            # Anything else missing is a corrupt/foreign checkpoint.
+            # Forward compatibility for KNOWN later-added fields only
+            # (round 4's cross-epoch handoff state; round 5's parallel-
+            # engine trace ring): synthesize the fresh-init default
+            # explicitly — ``like`` may be mid-run, and copying its leaf
+            # would inject stale soft state into the restore.  Anything
+            # else missing is a corrupt/foreign checkpoint.
             if field in ("ho_pay", "ho_epoch"):
                 leaves.append(_ho_default(field, leaf))
+                continue
+            if field in ("trace_node", "trace_round", "trace_time",
+                         "trace_count"):
+                leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]
@@ -82,6 +87,11 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
                 # the handoff cache is soft state, so restore it empty
                 # rather than failing the whole load.
                 leaves.append(_ho_default(field, leaf))
+                continue
+            if field in ("trace_node", "trace_round", "trace_time"):
+                # trace_cap changed between save and resume: the ring is
+                # diagnostic soft state — restart it empty.
+                leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
             raise ValueError(f"{key}: shape {arr.shape} != {leaf.shape}")
         leaves.append(arr.astype(leaf.dtype))

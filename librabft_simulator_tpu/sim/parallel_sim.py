@@ -136,6 +136,13 @@ class PSimState:
     n_msgs_sent: jnp.ndarray
     n_msgs_dropped: jnp.ndarray
     n_inbox_full: jnp.ndarray
+    # Round-switch trace ring (same layout as SimState so
+    # analysis/data_writer.py decodes both engines; entries are appended in
+    # window-schedule order — sort by time for a chronological view).
+    trace_node: jnp.ndarray
+    trace_round: jnp.ndarray
+    trace_time: jnp.ndarray
+    trace_count: jnp.ndarray
 
 
 def d_min_of(p: SimParams) -> int:
@@ -226,6 +233,10 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         n_msgs_sent=_i32(0),
         n_msgs_dropped=_i32(0),
         n_inbox_full=_i32(0),
+        trace_node=jnp.zeros((p.trace_cap,), I32),
+        trace_round=jnp.zeros((p.trace_cap,), I32),
+        trace_time=jnp.zeros((p.trace_cap,), I32),
+        trace_count=_i32(0),
     )
 
 
@@ -298,7 +309,8 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
 
     def drain_iter(c, _):
         (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
-         ev_n, drop_n) = c
+         ev_n, drop_n, tr_n, tr_r, tr_t, tr_c) = c
+        pm_pre_round = g_pm.active_round  # [A] for the round-switch trace
         t_l, k_l, slot_l, is_tm = _earliest(g_iv, g_it, g_ik, g_is, g_timer)
         act = lane_on & (t_l < hz) & (t_l <= st.max_clock)
         slot_c = jnp.maximum(slot_l, 0)
@@ -424,11 +436,25 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
 
         ev_n = ev_n + jnp.sum(act)
         drop_n = drop_n + jnp.sum(dropped)
+
+        # ---- Round-switch trace (mirrors sim/simulator.py; ring append in
+        # lane order, compiled out when trace_cap == 0).
+        switched_tr = do_update & (g_pm.active_round > pm_pre_round)
+        if p.trace_cap > 0:
+            tr_pos = tr_c + jnp.cumsum(switched_tr) - 1
+            # Index == cap is out-of-bounds and dropped (-1 would wrap).
+            tpos = jnp.where(switched_tr, jnp.remainder(tr_pos, p.trace_cap),
+                             _i32(p.trace_cap))
+            tr_n = tr_n.at[tpos].set(sel, mode="drop")
+            tr_r = tr_r.at[tpos].set(g_pm.active_round, mode="drop")
+            tr_t = tr_t.at[tpos].set(t_l, mode="drop")
+        tr_c = tr_c + jnp.sum(switched_tr)
+
         if _debug_tap is not None:
             jax.debug.callback(_debug_tap, act, t_l, k_l, sel, is_tm, g_ctr,
                                t_ev, hz, qualify, ordered=True)
         c2 = (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
-              ev_n, drop_n)
+              ev_n, drop_n, tr_n, tr_r, tr_t, tr_c)
         return c2, (go, kinds, recvs, stamps, arrive, pay_sel, banks)
 
     slicer = lambda x: x[sel]  # noqa: E731
@@ -436,10 +462,11 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         jax.tree.map(slicer, st.store), jax.tree.map(slicer, st.pm),
         jax.tree.map(slicer, st.node), jax.tree.map(slicer, st.ctx),
         st.in_valid[sel], st.timer_time[sel], st.node_ctr[sel],
-        st.ho_pay[sel], st.ho_epoch[sel], _i32(0), _i32(0))
+        st.ho_pay[sel], st.ho_epoch[sel], _i32(0), _i32(0),
+        st.trace_node, st.trace_round, st.trace_time, st.trace_count)
     carryN, ys = jax.lax.scan(drain_iter, carry0, None, length=K)
     (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe, ev_n,
-     drop_n) = carryN
+     drop_n, trace_node, trace_round, trace_time, trace_count) = carryN
     go_k, kind_k, recv_k, stamp_k, arrive_k, paysel_k, bank_k = ys  # [K, A, .]
 
     # ---- Scatter lane state back (sel indices are distinct; inactive lanes
@@ -521,6 +548,10 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         n_msgs_sent=st.n_msgs_sent + jnp.where(live, delivered, 0),
         n_msgs_dropped=st.n_msgs_dropped + jnp.where(live, drop_n, 0),
         n_inbox_full=st.n_inbox_full + jnp.where(live, jnp.sum(overflow_m), 0),
+        trace_node=trace_node,
+        trace_round=trace_round,
+        trace_time=trace_time,
+        trace_count=trace_count,
     )
 
 

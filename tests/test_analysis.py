@@ -36,6 +36,26 @@ def test_data_writer_outputs(tmp_path):
         assert json.load(f)["n_events"] == summary["n_events"]
 
 
+def test_data_writer_parallel_engine(tmp_path):
+    """The parallel engine carries the same on-device trace ring; DataWriter
+    decodes it identically (entries land in window-schedule order, but the
+    per-node switch times are the same monotone protocol quantity)."""
+    from librabft_simulator_tpu.sim import parallel_sim as P
+
+    p = SimParams(n_nodes=4, max_clock=800, delay_kind="uniform", window=8,
+                  chain_k=2, commit_log=16, trace_cap=1024)
+    st = P.run_to_completion(p, P.init_state(p, 7), chunk=64, max_chunks=200)
+    assert int(np.asarray(st.trace_count)) > 10
+    summary = DataWriter(p, str(tmp_path)).write(st)
+    with open(tmp_path / "round_switches.txt") as f:
+        rows = list(csv.reader(f))
+    assert len(rows) - 1 == summary["max_round"] + 1
+    for node in range(4):
+        times = [int(r[node]) for r in rows[1:] if r[node] != ""]
+        assert times == sorted(times)
+        assert len(times) > 3
+
+
 def test_round_plotter_ascii_and_png(tmp_path, capsys):
     p, st = run_traced()
     DataWriter(p, str(tmp_path)).write(st)
