@@ -76,6 +76,16 @@ def test_sweep_single_config():
     assert res["rounds_per_sec"] > 0
 
 
+def test_sweep_parallel_engine_config():
+    """run_config drives the lane engine for the wide-fleet configs."""
+    p = SimParams(n_nodes=4, max_clock=600, delay_kind="uniform", window=8,
+                  chain_k=2, commit_log=16)
+    res = sweeps.run_config(p, n_instances=6, engine=sweeps.P)
+    assert res["instances"] == 6
+    assert res["total_commits"] > 0
+    assert res["queue_full"] == 0
+
+
 def test_cli_main_json(capsys):
     from librabft_simulator_tpu.main import main
 
