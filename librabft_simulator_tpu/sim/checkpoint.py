@@ -60,11 +60,26 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
     arrays, treedef = _flatten_with_paths(like)
     leaves = []
     flat, _ = jax.tree_util.tree_flatten_with_path(like)
+
+    def _key(path):
+        return "/".join(
+            getattr(pp, "name", None) or str(getattr(pp, "idx", pp))
+            for pp in path)
+
+    # A trace_cap change resets the ring arrays below; the count must reset
+    # WITH them or the decoder reads `count` fabricated entries from an
+    # all-zero ring and post-resume writes start mid-ring.  (Its own shape
+    # never changes, so this must be decided up front.)
+    ring_reset = any(
+        _key(pth).split("/")[-1] == "trace_node" and _key(pth) in data
+        and data[_key(pth)].shape != lf.shape for pth, lf in flat)
+
     for path, leaf in flat:
-        key = "/".join(
-            getattr(pp, "name", None) or str(getattr(pp, "idx", pp)) for pp in path
-        )
+        key = _key(path)
         field = key.split("/")[-1]
+        if field == "trace_count" and ring_reset:
+            leaves.append(np.zeros(leaf.shape, leaf.dtype))
+            continue
         if key not in data:
             # Forward compatibility for KNOWN later-added fields only
             # (round 4's cross-epoch handoff state; round 5's parallel-

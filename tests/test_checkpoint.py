@@ -33,6 +33,34 @@ def test_resume_continues_identically(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_soft_state_compat_on_shape_change(tmp_path):
+    """Soft diagnostic/cache state survives capacity changes between save
+    and resume: a trace_cap=0 checkpoint restores into a traced config with
+    an empty, COHERENT ring (count reset with the arrays — a preserved
+    count over a zeroed ring would fabricate decoder entries), and a
+    resized handoff ring (grown and shrunk) restores empty (ho_epoch -1),
+    while PROTOCOL leaves restore exactly."""
+    p0 = SimParams(n_nodes=3, max_clock=400, trace_cap=0, handoff_epochs=2)
+    st = S.run_to_completion(p0, S.init_state(p0, 11))
+    assert int(np.asarray(st.trace_count)) > 0  # counted even when cap=0
+    f = str(tmp_path / "soft.npz")
+    C.save(f, st)
+
+    for e_new in (3, 1):  # grow and shrink the handoff ring
+        p1 = SimParams(n_nodes=3, max_clock=400, trace_cap=64,
+                       handoff_epochs=e_new)
+        st2 = C.load(f, p1, like=S.init_state(p1, 0))
+        np.testing.assert_array_equal(np.asarray(st2.trace_node),
+                                      np.zeros(64, np.int32))
+        assert int(np.asarray(st2.trace_count)) == 0  # coherent empty ring
+        np.testing.assert_array_equal(np.asarray(st2.ho_epoch),
+                                      np.full((3, e_new), -1, np.int32))
+        np.testing.assert_array_equal(np.asarray(st2.store.current_round),
+                                      np.asarray(st.store.current_round))
+        np.testing.assert_array_equal(np.asarray(st2.ctx.commit_count),
+                                      np.asarray(st.ctx.commit_count))
+
+
 def test_batched_checkpoint(tmp_path):
     p = SimParams(n_nodes=3, max_clock=300)
     st = S.run_to_completion(p, S.init_batch(p, np.arange(4, dtype=np.uint32)),
