@@ -10,10 +10,10 @@ Run: JAX_PLATFORMS=cpu python scripts/component_profile.py
 import os
 import time
 
+# CPU by default; JAX_PLATFORMS=axon profiles the chip (jax reads the env
+# var itself — no config.update needed).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
-
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 

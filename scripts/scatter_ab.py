@@ -1,0 +1,118 @@
+"""A/B the batched-scatter vs dense-one-hot forms on the live backend.
+
+The serial engine's step is dominated by per-instance dynamic-index ops
+(``x.at[i].set(v)`` / ``x[i]`` under ``vmap`` over B instances).  On CPU the
+scatters fuse in place and dense replacements measured SLOWER (PERF_NOTES);
+on TPU batched scatters lower to serialized update loops.  This script times
+both forms for the step's three characteristic shapes so the engine's
+``dense_updates`` auto mode is set by measurement, not folklore:
+
+  - store-table write:   [B, W=16, V=2] scatter at slot = round % W
+  - node-state write:    [B, N=4, F=8] row update at node a
+  - queue insert:        [B, CM=32] x C=9 candidate scatter
+
+Each form runs inside one jitted ``lax.scan`` of length ITERS so dispatch
+overhead is amortized and XLA sees the op in a loop (the in-graph regime
+PERF_NOTES says is the only one that decides).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+B = int(os.environ.get("AB_B", "8192"))
+ITERS = int(os.environ.get("AB_ITERS", "64"))
+
+
+def timed(name, make_scan, *args):
+    f = jax.jit(make_scan)
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(f(*args))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = jax.block_until_ready(f(*args))
+    dt = (time.perf_counter() - t0) / reps
+    print(json.dumps({"case": name, "per_scan_ms": round(dt * 1e3, 2),
+                      "per_iter_us": round(dt / ITERS * 1e6, 1),
+                      "compile_s": round(compile_s, 1)}), flush=True)
+    return out
+
+
+def scan(body):
+    def run(x, idx):
+        def f(carry, i):
+            return body(carry, idx, i), ()
+        return jax.lax.scan(f, x, jnp.arange(ITERS))[0]
+    return run
+
+
+def main():
+    print(json.dumps({"platform": jax.devices()[0].platform, "B": B,
+                      "iters": ITERS}), flush=True)
+    key = np.random.default_rng(0)
+
+    # ---- store-table write [B, 16, 2]
+    x = jnp.asarray(key.integers(0, 100, (B, 16, 2)), I32)
+    idx = jnp.asarray(key.integers(0, 16, (B,)), I32)
+
+    def sc_body(c, idx, i):
+        v = c[jnp.arange(B), idx, 0] + i
+        return jax.vmap(lambda cx, ix, vx: cx.at[ix, 0].set(vx))(c, idx, v)
+
+    def dn_body(c, idx, i):
+        hot = (jnp.arange(16)[None] == idx[:, None])  # [B, 16]
+        hot = hot[..., None] & (jnp.arange(2)[None, None] == 0)  # [B, 16, 2]
+        v = jnp.sum(jnp.where(hot, c, 0), axis=(1, 2)) + i  # == c[b, idx, 0]
+        return jnp.where(hot, v[:, None, None], c)
+
+    timed("store_scatter", scan(sc_body), x, idx)
+    timed("store_dense", scan(dn_body), x, idx)
+
+    # ---- node-row write [B, 4, 8]
+    xn = jnp.asarray(key.integers(0, 100, (B, 4, 8)), I32)
+    a = jnp.asarray(key.integers(0, 4, (B,)), I32)
+
+    def nsc(c, a, i):
+        row = jax.vmap(lambda cx, ax: cx[ax])(c, a) + i
+        return jax.vmap(lambda cx, ax, rx: cx.at[ax].set(rx))(c, a, row)
+
+    def ndn(c, a, i):
+        hot = (jnp.arange(4)[None] == a[:, None])  # [B, 4]
+        row = jnp.sum(jnp.where(hot[..., None], c, 0), axis=1) + i
+        return jnp.where(hot[..., None], row[:, None], c)
+
+    timed("node_scatter", scan(nsc), xn, a)
+    timed("node_dense", scan(ndn), xn, a)
+
+    # ---- queue insert: C=9 candidates into [B, 32]
+    q = jnp.asarray(key.integers(0, 100, (B, 32)), I32)
+    tgt = jnp.asarray(key.integers(0, 33, (B, 9)), I32)  # 32 == drop sentinel
+
+    def qsc(c, tgt, i):
+        vals = jnp.broadcast_to(i, (B, 9))
+        return jax.vmap(lambda cx, tx, vx: cx.at[tx].set(vx, mode="drop"))(
+            c, tgt, vals)
+
+    def qdn(c, tgt, i):
+        hot = (tgt[..., None] == jnp.arange(32)[None, None])  # [B, 9, 32]
+        any_hot = jnp.any(hot, axis=1)
+        return jnp.where(any_hot, i, c)
+
+    timed("queue_scatter", scan(qsc), q, tgt)
+    timed("queue_dense", scan(qdn), q, tgt)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Find the largest working fleet size on the tunneled TPU chip.
+
+The chip faults (UNAVAILABLE kernel fault) at B=32768 on the serial engine;
+this script climbs a ladder of batch sizes, timing each rung that works and
+recording each rung that faults, so the round's TPU measurement is the best
+the device can actually do.  Emits one JSON line per rung and a summary file
+(BENCH_TPU_LADDER_r05.json).
+
+Usage: python scripts/tpu_ladder.py [serial|parallel] [B ...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from librabft_simulator_tpu.utils.rlimit import raise_stack_limit
+
+raise_stack_limit()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def rung(engine_name: str, batch: int, chunk: int, reps: int) -> dict:
+    from librabft_simulator_tpu.core.types import SimParams
+    from librabft_simulator_tpu.sim import parallel_sim, simulator
+    from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+
+    engine = parallel_sim if engine_name == "parallel" else simulator
+    p = SimParams(n_nodes=4, delay_kind="uniform", max_clock=2**30,
+                  epoch_handoff=False, queue_cap=32)
+    out = {"engine": engine_name, "instances": batch, "chunk": chunk,
+           "reps": reps}
+    try:
+        seeds = np.arange(batch, dtype=np.uint32)
+        st = engine.init_batch(p, seeds)
+        st = dedupe_buffers(st)
+        run = engine.make_run_fn(p, chunk)
+        t0 = time.perf_counter()
+        st = run(st)
+        jax.block_until_ready(st)
+        out["compile_s"] = round(time.perf_counter() - t0, 1)
+        cur0 = jax.device_get(st.store.current_round)
+        e0 = int(np.sum(jax.device_get(st.n_events)))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            st = run(st)
+        jax.block_until_ready(st)
+        dt = time.perf_counter() - t0
+        cur1 = jax.device_get(st.store.current_round)
+        e1 = int(np.sum(jax.device_get(st.n_events)))
+        rounds = int(np.sum(np.max(cur1, -1)) - np.sum(np.max(cur0, -1)))
+        # Fidelity guards matching bench.py::_time_engine: overflow-loss
+        # accounting, and the epoch_handoff=False premise checked.
+        lost_field = (st.n_queue_full if hasattr(st, "n_queue_full")
+                      else st.n_inbox_full)
+        lost = int(np.sum(jax.device_get(lost_field)))
+        sent = int(np.sum(jax.device_get(st.n_msgs_sent)))
+        max_epoch = int(np.max(jax.device_get(st.store.epoch_id)))
+        assert max_epoch == 0, (
+            f"ladder crossed an epoch boundary (max epoch {max_epoch}) "
+            "with epoch_handoff=False")
+        out.update(ok=True, elapsed_s=round(dt, 3),
+                   rounds_per_sec=round(rounds / dt, 1),
+                   events_per_sec=round((e1 - e0) / dt, 1),
+                   overflow_frac=round(lost / max(sent + lost, 1), 4))
+    except Exception as e:  # noqa: BLE001 - record the fault and keep going
+        out.update(ok=False, error=f"{type(e).__name__}: {e}"[:300])
+    return out
+
+
+def main() -> None:
+    engine = sys.argv[1] if len(sys.argv) > 1 else "serial"
+    ladder = ([int(x) for x in sys.argv[2:]]
+              or [2048, 4096, 8192, 16384, 24576, 32768])
+    chunk = int(os.environ.get("LADDER_CHUNK", "64"))
+    reps = int(os.environ.get("LADDER_REPS", "2"))
+    rows = []
+    for b in ladder:
+        r = rung(engine, b, chunk, reps)
+        r["platform"] = jax.devices()[0].platform
+        print(json.dumps(r), flush=True)
+        rows.append(r)
+        if not r["ok"]:
+            break  # a faulted device often wedges the session; stop clean
+    suffix = "" if engine == "serial" else f"_{engine}"
+    with open(f"BENCH_TPU_LADDER{suffix}_r05.json", "w") as f:
+        json.dump({"ladder": rows}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
