@@ -279,6 +279,14 @@ def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
         select = "xla"
     if engine_name == "serial":  # the parallel engine has no select path
         params_kw.setdefault("select_kernel", select)
+    # Unroll the protocol-interior scans on TPU: their while-loops are ~half
+    # the on-chip step time (+18% events/s measured at B=2048), while on CPU
+    # rolled scans are faster to compile and equally fast to run.  Gated to
+    # n <= 16 because the timeout-batch scan body is replicated n times when
+    # unrolled — wider fleets (n=32/64 sweep shapes) keep rolled scans to
+    # protect the compile budget.
+    params_kw.setdefault(
+        "unroll", jax.devices()[0].platform != "cpu" and n_nodes <= 16)
     p = SimParams(
         n_nodes=n_nodes,
         delay_kind=delay_kind,
@@ -298,8 +306,15 @@ def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
 def run_all() -> dict:
     platform = jax.devices()[0].platform
     on_tpu = platform != "cpu"
-    batch = int(os.environ.get("BENCH_B", 32768 if on_tpu else 2048))
-    chunk = int(os.environ.get("BENCH_STEPS", 128 if on_tpu else 32))
+    # Same B/chunk on both backends so the TPU headline is directly
+    # comparable to the CPU-fallback and prior-round numbers.  Measured on
+    # chip (BENCH_TPU_LADDER_r05.json): events/s is FLAT in B from 2048 to
+    # 32768 (the step is kernel-count-bound, not width-bound), so a bigger
+    # fleet only drags rounds_per_sec down via the later, slower-round
+    # regime; and calls of B*chunk >= ~4M events exceed the tunnel relay's
+    # execution window and fault the device.
+    batch = int(os.environ.get("BENCH_B", 2048))
+    chunk = int(os.environ.get("BENCH_STEPS", 32))
     reps = int(os.environ.get("BENCH_REPS", 4 if on_tpu else 2))
     n_nodes = int(os.environ.get("BENCH_NODES", 4))
     mode = os.environ.get("BENCH_ENGINE", "both")

@@ -2,8 +2,11 @@
 (/root/reference/bft-lib/src/configuration.rs:18-76).
 
 Voting rights are an int32 vector ``weights[N]`` (index = author).  Author
-picking is cumsum + searchsorted instead of the reference's linear scan, so it
-vectorizes across instances and stays O(log N) per lookup on device.
+picking is cumsum + a branchless right-insertion count instead of the
+reference's linear scan: O(N) elementwise work that vectorizes across
+instances with no data-dependent control flow (jnp.searchsorted's O(log N)
+binary search lowers to an XLA while loop, which costs more per TPU step
+than the whole N-element sum).
 """
 
 from __future__ import annotations
@@ -38,7 +41,11 @@ def pick_author(weights, seed_u32):
     total = total_votes(weights).astype(jnp.uint32)
     target = (seed_u32.astype(jnp.uint32) % total).astype(jnp.int32)
     cum = jnp.cumsum(weights, axis=-1)
-    return jnp.searchsorted(cum, target, side="right").astype(jnp.int32)
+    # Right-insertion point == #{i : cum[i] <= target}.  Branchless on
+    # purpose: jnp.searchsorted lowers to an XLA while-loop binary search,
+    # which costs more per TPU step than this whole N-element sum.
+    return jnp.sum((cum <= jnp.expand_dims(target, -1)).astype(jnp.int32),
+                   axis=-1)
 
 
 def leader_of_round(weights, round_):

@@ -132,7 +132,7 @@ def _insert_timeout_batch(p, s, weights, to_msg, rec_epoch):
         )
         return store_ops._sel(to_msg.valid[a], st2, st), None
 
-    s, _ = jax.lax.scan(body, s, jnp.arange(p.n_nodes))
+    s, _ = jax.lax.scan(body, s, jnp.arange(p.n_nodes), unroll=p.unroll)
     return s
 
 
@@ -243,7 +243,8 @@ def handle_response(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         return st_, None
 
     skip = do_jump & (jnp.arange(p.chain_k) == 0)
-    s, _ = jax.lax.scan(replay, s, (pay.chain_blk, pay.chain_qc, skip))
+    s, _ = jax.lax.scan(replay, s, (pay.chain_blk, pay.chain_qc, skip),
+                        unroll=p.unroll)
     # Highest commit certificate with its block, then the rest.
     s2, _ = store_ops.insert_block(p, s, weights, pay.hcc_blk, pay.epoch)
     s = store_ops._sel(pay.hcc_blk.valid, s2, s)

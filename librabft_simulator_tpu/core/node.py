@@ -180,7 +180,7 @@ def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         jnp.bool_(False), jnp.bool_(False), _i32(0), _i32(0), jnp.zeros((), jnp.uint32),
     )
     (cc, lc_d, lc_t, sk, lr, ld, lt, _, sw, sw_e, sw_d, sw_t), _ = jax.lax.scan(
-        deliver, init, (keep, rounds, depths, tags)
+        deliver, init, (keep, rounds, depths, tags), unroll=p.unroll
     )
     ctx = ctx.replace(
         commit_count=cc, last_depth=lc_d, last_tag=lc_t, skipped_commits=sk,

@@ -127,7 +127,8 @@ def qc_walk_back(p: SimParams, s: Store, start_valid, start_round, start_var, st
 
     init = (jnp.asarray(start_valid) & (start_round > s.initial_round),
             _i32(start_round), _i32(start_var))
-    _, (valids, rounds, vars_, hits) = jax.lax.scan(body, init, None, length=steps)
+    _, (valids, rounds, vars_, hits) = jax.lax.scan(
+        body, init, None, length=steps, unroll=p.unroll)
     return valids, rounds, vars_, hits
 
 

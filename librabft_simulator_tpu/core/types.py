@@ -98,6 +98,14 @@ class SimParams:
     # "pallas_interpret" (same kernel, interpreter mode — CPU testable).
     # All three are bit-identical (tests/test_ops.py).
     select_kernel: str = "xla"
+    # Fully unroll the small protocol-interior lax.scans (QC chain walks,
+    # commit delivery, K-tail replay, timeout batches).  Rolled scans keep
+    # the compiled graph small — right for CPU and for n=64 configs — but
+    # every scan lowers to an XLA while loop that TPU executes with
+    # per-iteration kernel-dispatch overhead; profiling at B=2048 shows
+    # those whiles are ~half the on-chip step time.  Trajectories are
+    # bit-identical either way (tests/test_parity.py::test_unroll_parity).
+    unroll: bool = False
     # Network.
     shuffle_receivers: bool = False  # seeded per-event receiver permutation
                                      # (simulator.rs:343 fuzzing semantics);

@@ -43,8 +43,12 @@ def _select_kernel(time_ref, kind_ref, stamp_ref, idx_ref, tmin_ref):
     m = t.shape[1]
     cols = jax.lax.broadcasted_iota(jnp.int32, t.shape, 1)
     idx = jnp.min(jnp.where(c3, cols, m), axis=1)
-    idx_ref[:] = idx
-    tmin_ref[:] = t_min[:, 0]
+    # Outputs are [bB, LANE] with the scalar result broadcast across the
+    # lane dim: TPU lowering requires the last block dim be 128-divisible
+    # (or equal to the array dim), which a [bB] 1-D output can never
+    # satisfy — compiled mode rejects it.  The caller reads lane 0.
+    idx_ref[:] = jnp.broadcast_to(idx[:, None], idx_ref.shape)
+    tmin_ref[:] = jnp.broadcast_to(t_min, tmin_ref.shape)
 
 
 @functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
@@ -70,17 +74,17 @@ def select_events(times, kinds, stamps, block_b: int = 8,
         grid=grid,
         in_specs=[spec, spec, spec],
         out_specs=[
-            pl.BlockSpec((block_b,), lambda i: (i,)),
-            pl.BlockSpec((block_b,), lambda i: (i,)),
+            pl.BlockSpec((block_b, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, LANE), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((Bp,), jnp.int32),
-            jax.ShapeDtypeStruct((Bp,), jnp.int32),
+            jax.ShapeDtypeStruct((Bp, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((Bp, LANE), jnp.int32),
         ],
         interpret=interpret,
     )(times, kinds, stamps)
     idx, tmin = out
-    return idx[:B], tmin[:B]
+    return idx[:B, 0], tmin[:B, 0]
 
 
 def select_events_reference(times, kinds, stamps):

@@ -20,14 +20,18 @@ import numpy as np
 from ..core.types import SimParams, SimState
 
 
+def _key(path) -> str:
+    """Stable string key for a tree path — the single source of the
+    save/load key-derivation rule."""
+    return "/".join(
+        getattr(p, "name", None) or str(getattr(p, "idx", p)) for p in path)
+
+
 def _flatten_with_paths(state):
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
     out = {}
     for path, leaf in flat:
-        key = "/".join(
-            getattr(p, "name", None) or str(getattr(p, "idx", p)) for p in path
-        )
-        out[key] = np.asarray(jax.device_get(leaf))
+        out[_key(path)] = np.asarray(jax.device_get(leaf))
     return out, treedef
 
 
@@ -57,25 +61,19 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
             like = S.init_batch(p, np.zeros(sample.shape[0], np.uint32))
         else:
             like = S.init_state(p, 0)
-    arrays, treedef = _flatten_with_paths(like)
     leaves = []
-    flat, _ = jax.tree_util.tree_flatten_with_path(like)
-
-    def _key(path):
-        return "/".join(
-            getattr(pp, "name", None) or str(getattr(pp, "idx", pp))
-            for pp in path)
+    flat = [(_key(path), leaf)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(like)[0]]
 
     # A trace_cap change resets the ring arrays below; the count must reset
     # WITH them or the decoder reads `count` fabricated entries from an
     # all-zero ring and post-resume writes start mid-ring.  (Its own shape
     # never changes, so this must be decided up front.)
     ring_reset = any(
-        _key(pth).split("/")[-1] == "trace_node" and _key(pth) in data
-        and data[_key(pth)].shape != lf.shape for pth, lf in flat)
+        k.split("/")[-1] == "trace_node" and k in data
+        and data[k].shape != lf.shape for k, lf in flat)
 
-    for path, leaf in flat:
-        key = _key(path)
+    for key, leaf in flat:
         field = key.split("/")[-1]
         if field == "trace_count" and ring_reset:
             leaves.append(np.zeros(leaf.shape, leaf.dtype))

@@ -37,9 +37,10 @@ def rung(engine_name: str, batch: int, chunk: int, reps: int) -> dict:
 
     engine = parallel_sim if engine_name == "parallel" else simulator
     p = SimParams(n_nodes=4, delay_kind="uniform", max_clock=2**30,
-                  epoch_handoff=False, queue_cap=32)
+                  epoch_handoff=False, queue_cap=32,
+                  unroll=os.environ.get("LADDER_UNROLL", "0") == "1")
     out = {"engine": engine_name, "instances": batch, "chunk": chunk,
-           "reps": reps}
+           "reps": reps, "unroll": p.unroll}
     try:
         seeds = np.arange(batch, dtype=np.uint32)
         st = engine.init_batch(p, seeds)
@@ -93,6 +94,8 @@ def main() -> None:
         if not r["ok"]:
             break  # a faulted device often wedges the session; stop clean
     suffix = "" if engine == "serial" else f"_{engine}"
+    if rows and rows[0].get("unroll"):
+        suffix += "_unroll"
     with open(f"BENCH_TPU_LADDER{suffix}_r05.json", "w") as f:
         json.dump({"ladder": rows}, f, indent=1)
 

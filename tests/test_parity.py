@@ -1,6 +1,8 @@
 """North-star parity: the jitted JAX trajectory is bit-identical to the
 pure-Python oracle (BASELINE.json: 'commit sequences byte-identical')."""
 
+import dataclasses
+
 import jax
 import numpy as np
 import pytest
@@ -106,3 +108,16 @@ def test_parity_long_stall_wide_durations():
     p = SimParams(n_nodes=4, max_clock=3_000_000, drop_prob=0.5, gamma=4.0)
     st, orc = assert_parity(p, 23)
     assert max(o.round_duration for o in orc.pms) > 65536
+
+
+def test_unroll_parity():
+    """SimParams.unroll only changes how XLA lowers the interior scans
+    (rolled while-loops vs unrolled bodies) — the trajectory must be
+    bit-identical, including the pick_author branchless form."""
+    p = SimParams(n_nodes=4, max_clock=800, delay_kind="uniform")
+    st_rolled = jax_run(p, 7)
+    st_unrolled = jax_run(dataclasses.replace(p, unroll=True), 7)
+    flat_a = jax.tree_util.tree_leaves(st_rolled)
+    flat_b = jax.tree_util.tree_leaves(st_unrolled)
+    for xa, xb in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
