@@ -17,6 +17,7 @@ from flax import struct
 
 from . import pacemaker as pm_ops
 from . import store as store_ops
+from ..utils.xops import wset
 from .types import (
     NEVER, Context, NodeExtra, Pacemaker, SimParams, Store, pack_payload,
     sat_add,
@@ -154,9 +155,9 @@ def process_commits(p: SimParams, s: Store, nx: NodeExtra, ctx: Context, weights
         do = valid & ~stopped & (d > lc_d)
         # StateFinalizer::commit (simulated_context.rs:161-185): ring append.
         pos = jnp.remainder(cc, H_)
-        lr = jnp.where(do, lr.at[pos].set(r), lr)
-        ld = jnp.where(do, ld.at[pos].set(d), ld)
-        lt = jnp.where(do, lt.at[pos].set(t), lt)
+        lr = wset(lr, pos, r, when=do)
+        ld = wset(ld, pos, d, when=do)
+        lt = wset(lt, pos, t, when=do)
         cc = cc + jnp.where(do, 1, 0)
         # Depths between the last delivery and this one were bypassed (the
         # K-tail response didn't carry their records): account them.

@@ -38,6 +38,7 @@ from .types import (
     VoteMsg,
 )
 from ..utils import hashing as H
+from ..utils.xops import wset
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -332,15 +333,15 @@ def insert_block(p: SimParams, s: Store, weights, b: BlockMsg, rec_epoch):
     )
     var = jnp.maximum(var, 0)
     s2 = s.replace(
-        blk_valid=s.blk_valid.at[sl, var].set(True),
-        blk_round=s.blk_round.at[sl, var].set(b.round),
-        blk_author=s.blk_author.at[sl, var].set(b.author),
-        blk_prev_round=s.blk_prev_round.at[sl, var].set(b.prev_round),
-        blk_prev_tag=s.blk_prev_tag.at[sl, var].set(b.prev_tag),
-        blk_time=s.blk_time.at[sl, var].set(b.time),
-        blk_cmd_proposer=s.blk_cmd_proposer.at[sl, var].set(b.cmd_proposer),
-        blk_cmd_index=s.blk_cmd_index.at[sl, var].set(b.cmd_index),
-        blk_tag=s.blk_tag.at[sl, var].set(b.tag),
+        blk_valid=wset(s.blk_valid, (sl, var), True),
+        blk_round=wset(s.blk_round, (sl, var), b.round),
+        blk_author=wset(s.blk_author, (sl, var), b.author),
+        blk_prev_round=wset(s.blk_prev_round, (sl, var), b.prev_round),
+        blk_prev_tag=wset(s.blk_prev_tag, (sl, var), b.prev_tag),
+        blk_time=wset(s.blk_time, (sl, var), b.time),
+        blk_cmd_proposer=wset(s.blk_cmd_proposer, (sl, var), b.cmd_proposer),
+        blk_cmd_index=wset(s.blk_cmd_index, (sl, var), b.cmd_index),
+        blk_tag=wset(s.blk_tag, (sl, var), b.tag),
     )
     # current_proposed_block (record_store.rs:468-474): only the legitimate
     # leader's block at the current round becomes the proposal.
@@ -374,13 +375,13 @@ def insert_vote(p: SimParams, s: Store, weights, v: VoteMsg):
     )
     bvar = jnp.maximum(bvar, 0)
     s2 = s.replace(
-        vt_valid=s.vt_valid.at[author].set(True),
-        vt_blk_var=s.vt_blk_var.at[author].set(bvar),
-        vt_state_depth=s.vt_state_depth.at[author].set(v.state_depth),
-        vt_state_tag=s.vt_state_tag.at[author].set(v.state_tag),
-        vt_commit_valid=s.vt_commit_valid.at[author].set(v.commit_valid),
-        vt_commit_depth=s.vt_commit_depth.at[author].set(v.commit_depth),
-        vt_commit_tag=s.vt_commit_tag.at[author].set(v.commit_tag),
+        vt_valid=wset(s.vt_valid, author, True),
+        vt_blk_var=wset(s.vt_blk_var, author, bvar),
+        vt_state_depth=wset(s.vt_state_depth, author, v.state_depth),
+        vt_state_tag=wset(s.vt_state_tag, author, v.state_tag),
+        vt_commit_valid=wset(s.vt_commit_valid, author, v.commit_valid),
+        vt_commit_depth=wset(s.vt_commit_depth, author, v.commit_depth),
+        vt_commit_tag=wset(s.vt_commit_tag, author, v.commit_tag),
     )
     # Ballot update (ElectionState::Ongoing only).
     ongoing = s.election == ELECTION_ONGOING
@@ -400,10 +401,10 @@ def insert_vote(p: SimParams, s: Store, weights, v: VoteMsg):
     new_weight = s2.bal_weight[bvar, slot] + w
     do_ballot = ongoing & has_slot
     s3 = s2.replace(
-        bal_used=s2.bal_used.at[bvar, slot].set(True),
-        bal_weight=s2.bal_weight.at[bvar, slot].set(new_weight),
-        bal_state_depth=s2.bal_state_depth.at[bvar, slot].set(v.state_depth),
-        bal_state_tag=s2.bal_state_tag.at[bvar, slot].set(v.state_tag),
+        bal_used=wset(s2.bal_used, (bvar, slot), True),
+        bal_weight=wset(s2.bal_weight, (bvar, slot), new_weight),
+        bal_state_depth=wset(s2.bal_state_depth, (bvar, slot), v.state_depth),
+        bal_state_tag=wset(s2.bal_state_tag, (bvar, slot), v.state_tag),
     )
     won = do_ballot & (new_weight >= config.quorum_threshold(weights))
     s3 = s3.replace(
@@ -469,18 +470,18 @@ def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
     )
     var = jnp.maximum(var, 0)
     s2 = s.replace(
-        qc_valid=s.qc_valid.at[sl, var].set(True),
-        qc_round=s.qc_round.at[sl, var].set(q.round),
-        qc_blk_var=s.qc_blk_var.at[sl, var].set(bvar_c),
-        qc_state_depth=s.qc_state_depth.at[sl, var].set(q.state_depth),
-        qc_state_tag=s.qc_state_tag.at[sl, var].set(q.state_tag),
-        qc_commit_valid=s.qc_commit_valid.at[sl, var].set(q.commit_valid),
-        qc_commit_depth=s.qc_commit_depth.at[sl, var].set(q.commit_depth),
-        qc_commit_tag=s.qc_commit_tag.at[sl, var].set(q.commit_tag),
-        qc_votes_lo=s.qc_votes_lo.at[sl, var].set(q.votes_lo),
-        qc_votes_hi=s.qc_votes_hi.at[sl, var].set(q.votes_hi),
-        qc_author=s.qc_author.at[sl, var].set(q.author),
-        qc_tag=s.qc_tag.at[sl, var].set(q.tag),
+        qc_valid=wset(s.qc_valid, (sl, var), True),
+        qc_round=wset(s.qc_round, (sl, var), q.round),
+        qc_blk_var=wset(s.qc_blk_var, (sl, var), bvar_c),
+        qc_state_depth=wset(s.qc_state_depth, (sl, var), q.state_depth),
+        qc_state_tag=wset(s.qc_state_tag, (sl, var), q.state_tag),
+        qc_commit_valid=wset(s.qc_commit_valid, (sl, var), q.commit_valid),
+        qc_commit_depth=wset(s.qc_commit_depth, (sl, var), q.commit_depth),
+        qc_commit_tag=wset(s.qc_commit_tag, (sl, var), q.commit_tag),
+        qc_votes_lo=wset(s.qc_votes_lo, (sl, var), q.votes_lo),
+        qc_votes_hi=wset(s.qc_votes_hi, (sl, var), q.votes_hi),
+        qc_author=wset(s.qc_author, (sl, var), q.author),
+        qc_tag=wset(s.qc_tag, (sl, var), q.tag),
     )
     newer = q.round > s2.hqc_round
     s2 = s2.replace(
@@ -503,8 +504,8 @@ def insert_timeout(p: SimParams, s: Store, weights, t_epoch, t_round, t_hcbr, t_
     )
     new_weight = s.to_weight + weights[author]
     s2 = s.replace(
-        to_valid=s.to_valid.at[author].set(True),
-        to_hcbr=s.to_hcbr.at[author].set(t_hcbr),
+        to_valid=wset(s.to_valid, author, True),
+        to_hcbr=wset(s.to_hcbr, author, t_hcbr),
         to_weight=new_weight,
     )
     tc = new_weight >= config.quorum_threshold(weights)

@@ -86,6 +86,7 @@ from ..core.types import (
     unpack_payload,
 )
 from ..utils import hashing as H
+from ..utils.xops import wset
 from ..utils.quantile import TABLE_BITS
 
 I32 = jnp.int32
@@ -317,8 +318,10 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         pay_rows = jnp.take_along_axis(g_ipay, slot_c[:, None, None], axis=1)[:, 0]
         sender = jnp.take_along_axis(g_isnd, slot_c[:, None], axis=1)[:, 0]
         consume = act & ~is_tm
-        g_iv = g_iv.at[jnp.arange(A), slot_c].set(
-            jnp.where(consume, False, g_iv[jnp.arange(A), slot_c]))
+        # Per-lane scalar write via wset (utils/xops.py — scalar-per-row
+        # scatters miscompile on the axon TPU stack).
+        g_iv = jax.vmap(lambda row, i, c: wset(row, i, False, when=c))(
+            g_iv, slot_c, consume)
 
         is_notify = act & ~is_tm & (k_l == KIND_NOTIFY)
         is_request = act & ~is_tm & (k_l == KIND_REQUEST)
@@ -357,10 +360,8 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
                 E = p.handoff_epochs
                 switched = do_update[i] & actions.ho_switched
                 wslot = jnp.remainder(jnp.maximum(actions.ho_epoch, 0), E)
-                ho_row = store_ops._sel(
-                    switched, ho_row.at[wslot].set(actions.ho_pack), ho_row)
-                ho_ep = store_ops._sel(
-                    switched, ho_ep.at[wslot].set(actions.ho_epoch), ho_ep)
+                ho_row = wset(ho_row, wslot, actions.ho_pack, when=switched)
+                ho_ep = wset(ho_ep, wslot, actions.ho_epoch, when=switched)
                 rslot = jnp.remainder(jnp.maximum(pay_in.epoch, 0), E)
                 serve_ho = (is_request[i] & (ho_ep[rslot] == pay_in.epoch)
                             & (pay_in.epoch < s_f.epoch_id))

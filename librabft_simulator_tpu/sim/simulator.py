@@ -48,6 +48,7 @@ from ..core.types import (
     unpack_payload,
 )
 from ..utils import hashing as H
+from ..utils.xops import wset
 from ..utils.quantile import TABLE_BITS
 
 I32 = jnp.int32
@@ -63,7 +64,7 @@ def _node_slice(tree, a):
 
 
 def _node_update(tree, a, new):
-    return jax.tree.map(lambda x, v: x.at[a].set(v), tree, new)
+    return jax.tree.map(lambda x, v: wset(x, a, v), tree, new)
 
 
 def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
@@ -208,8 +209,8 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     sender = st.queue.sender[midx]
     pay_in = unpack_payload(p, st.queue.payload[midx])
     # Consume the message slot.
-    queue = st.queue.replace(valid=st.queue.valid.at[midx].set(
-        jnp.where(live & ~is_timer, False, st.queue.valid[midx])))
+    queue = st.queue.replace(
+        valid=wset(st.queue.valid, midx, False, when=live & ~is_timer))
 
     # ---- Node slices.
     s_a = _node_slice(st.store, a)
@@ -259,12 +260,10 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         wslot = jnp.remainder(jnp.maximum(actions.ho_epoch, 0), E)
         rows_a = st.ho_pay[a]       # [E, F]
         eps_a = st.ho_epoch[a]      # [E]
-        rows_a = store_ops._sel(switched, rows_a.at[wslot].set(actions.ho_pack),
-                                rows_a)
-        eps_a = store_ops._sel(switched, eps_a.at[wslot].set(actions.ho_epoch),
-                               eps_a)
-        ho_pay = st.ho_pay.at[a].set(rows_a)
-        ho_epoch = st.ho_epoch.at[a].set(eps_a)
+        rows_a = wset(rows_a, wslot, actions.ho_pack, when=switched)
+        eps_a = wset(eps_a, wslot, actions.ho_epoch, when=switched)
+        ho_pay = wset(st.ho_pay, a, rows_a)
+        ho_epoch = wset(st.ho_epoch, a, eps_a)
         rslot = jnp.remainder(jnp.maximum(pay_in.epoch, 0), E)
         serve_ho = (is_request & (eps_a[rslot] == pay_in.epoch)
                     & (pay_in.epoch < s_f.epoch_id))
@@ -365,10 +364,8 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     # negative next_sched (pre-startup local times).
     next_g = sat_add(actions.next_sched, st.startup[a])
     new_timer = jnp.maximum(next_g, clock + 1)
-    timer_time = jnp.where(do_update, st.timer_time.at[a].set(new_timer), st.timer_time)
-    timer_stamp = jnp.where(
-        do_update, st.timer_stamp.at[a].set(timer_stamp_new), st.timer_stamp
-    )
+    timer_time = wset(st.timer_time, a, new_timer, when=do_update)
+    timer_stamp = wset(st.timer_stamp, a, timer_stamp_new, when=do_update)
 
     # ---- Round-switch trace (data_writer.rs:34-49): the handled node entered
     # a higher pacemaker round.  Ring write; compiled out when trace_cap == 0.
@@ -376,11 +373,10 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     trace_count = st.trace_count + jnp.where(switched, 1, 0)
     if p.trace_cap > 0:
         # Index == cap is out-of-bounds and dropped (a -1 sentinel would wrap).
-        tpos = jnp.where(switched, jnp.remainder(st.trace_count, p.trace_cap),
-                         _i32(p.trace_cap))
-        trace_node = st.trace_node.at[tpos].set(a, mode="drop")
-        trace_round = st.trace_round.at[tpos].set(pm_f.active_round, mode="drop")
-        trace_time = st.trace_time.at[tpos].set(clock, mode="drop")
+        tpos = jnp.remainder(st.trace_count, p.trace_cap)
+        trace_node = wset(st.trace_node, tpos, a, when=switched)
+        trace_round = wset(st.trace_round, tpos, pm_f.active_round, when=switched)
+        trace_time = wset(st.trace_time, tpos, clock, when=switched)
     else:
         trace_node, trace_round, trace_time = (
             st.trace_node, st.trace_round, st.trace_time)

@@ -1,0 +1,46 @@
+"""Scatter-free scalar writes.
+
+``wset(arr, idx, val)`` is ``arr.at[idx].set(val)`` for *scalar* indices,
+expressed as a one-hot ``jnp.where`` instead of an XLA scatter.
+
+Why this exists: on the axon TPU stack, a vmapped scalar scatter into a
+small trailing dim followed by a select miscomputes for a data-dependent
+subset of batch rows at B >= ~2048 (repro: scripts/tpu_scatter_bug_repro.py
+— ``vmap(lambda b, a, o: where(o, b.at[a].set(True), b))`` disagrees with
+CPU on ~18% of rows; int8 and gated-scatter variants fail too, the where
+one-hot form is correct).  The serial engine's consensus state was silently
+corrupted at bench scale (21 vs 34,144 commits at B=2048 x 192 events)
+until every scalar store/node/queue write went through this form.  The
+where form is also fusion-friendly on TPU: it removes a scatter kernel
+boundary per write.
+
+Semantics note: out-of-range (including negative) indices write NOTHING —
+i.e. ``mode="drop"``, which is what every call site wants (sentinel
+indices == array length express "skip this write").  This differs from
+``.at[]``'s default clip-at-edge for negative indices; call sites clip
+their indices where a write must always land.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def wset(arr, idx, val, when=None):
+    """``arr.at[idx].set(val)`` via one-hot where; scalar indices only.
+
+    ``idx``: a scalar index into dim 0, or a tuple of scalars indexing the
+    leading dims.  ``val`` must broadcast against the indexed slice shape.
+    ``when`` (optional bool scalar) gates the whole write — replaces the
+    ``jnp.where(cond, arr.at[i].set(v), arr)`` pattern (the exact shape
+    the TPU miscompile hits).
+    """
+    idxs = idx if isinstance(idx, tuple) else (idx,)
+    mask = jnp.bool_(True) if when is None else when
+    for d, ix in enumerate(idxs):
+        shape = [1] * arr.ndim
+        shape[d] = arr.shape[d]
+        mask = mask & (jnp.arange(arr.shape[d]).reshape(shape) == ix)
+    # .at[].set casts the value to the array dtype; mirror that exactly so
+    # call sites behave identically to the scatter they replace.
+    return jnp.where(mask, jnp.asarray(val, arr.dtype), arr)

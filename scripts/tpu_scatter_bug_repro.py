@@ -1,0 +1,61 @@
+"""Minimal repro of the axon-TPU batched scalar-scatter miscompile.
+
+A vmapped scalar scatter into a small trailing dim, followed by a select,
+returns wrong rows for a data-dependent ~18% of a B=2048 batch (B=64 is
+fine).  int8 casting and folding the condition into a dropped-OOB scatter
+index do NOT help; the one-hot ``jnp.where`` form is correct — which is why
+the whole engine writes scalar slots through ``utils/xops.wset``.
+
+Found round 5: the serial engine's vote table (`vt_valid`, bool [B, 4])
+was silently corrupted at bench scale — 21 total commits instead of 34,144
+at B=2048 x 192 events — while every B=64 parity check passed.
+
+Run on a machine with the TPU tunnel up: ``python scripts/tpu_scatter_bug_repro.py``.
+Prints one JSON line per form; "bug_present": true means the scatter form
+still disagrees with ground truth on this stack.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, N = 2048, 4
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    base = jnp.asarray(rng.random((B, N)) < 0.3)
+    idx = jnp.asarray(rng.integers(0, N, B), jnp.int32)
+    ok = jnp.asarray(rng.random(B) < 0.5)
+
+    gt = np.array(base)
+    for i in range(B):
+        if ok[i]:
+            gt[i, idx[i]] = True
+
+    def scatter_select(b, a, o):
+        return jnp.where(o, b.at[a].set(True), b)
+
+    def where_onehot(b, a, o):
+        return jnp.where((jnp.arange(N) == a) & o, True, b)
+
+    dev = jax.devices()[0]
+    print(json.dumps({"platform": dev.platform, "B": B, "N": N}))
+    if dev.platform == "cpu":
+        print(json.dumps({"error": "needs the TPU backend"}))
+        sys.exit(1)
+    for name, fn in (("scatter_select", scatter_select),
+                     ("where_onehot", where_onehot)):
+        out = np.asarray(jax.jit(jax.vmap(fn))(base, idx, ok))
+        n_bad = int(np.sum((out != gt).any(axis=1)))
+        print(json.dumps({"form": name, "bad_rows": n_bad,
+                          "bug_present": n_bad > 0}))
+
+
+if __name__ == "__main__":
+    main()

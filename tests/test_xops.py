@@ -1,0 +1,74 @@
+"""utils/xops.wset: the scatter-free scalar write the whole engine uses.
+
+wset exists because vmapped scalar scatters miscompile on the axon TPU
+stack (scripts/tpu_scatter_bug_repro.py); these tests pin its semantics
+against .at[].set on CPU, including the drop-on-out-of-range contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from librabft_simulator_tpu.utils.xops import wset
+
+
+def test_wset_matches_at_set_1d():
+    arr = jnp.arange(8, dtype=jnp.int32)
+    for i in range(8):
+        np.testing.assert_array_equal(
+            np.asarray(wset(arr, jnp.int32(i), 99)),
+            np.asarray(arr.at[i].set(99)))
+
+
+def test_wset_tuple_index_2d():
+    arr = jnp.arange(12, dtype=jnp.int32).reshape(3, 4)
+    out = wset(arr, (jnp.int32(1), jnp.int32(2)), -7)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(arr.at[1, 2].set(-7)))
+
+
+def test_wset_row_value_broadcast():
+    arr = jnp.zeros((4, 5), jnp.int32)
+    row = jnp.arange(5, dtype=jnp.int32)
+    out = wset(arr, jnp.int32(2), row)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(arr.at[2].set(row)))
+
+
+def test_wset_out_of_range_drops():
+    arr = jnp.arange(4, dtype=jnp.int32)
+    # Sentinel == length and negative indices write nothing (mode="drop"
+    # semantics; .at[] would clip negatives — call sites rely on drop).
+    np.testing.assert_array_equal(np.asarray(wset(arr, jnp.int32(4), 99)),
+                                  np.asarray(arr))
+    np.testing.assert_array_equal(np.asarray(wset(arr, jnp.int32(-1), 99)),
+                                  np.asarray(arr))
+
+
+def test_wset_when_gates_the_write():
+    arr = jnp.zeros((4,), jnp.bool_)
+    on = wset(arr, jnp.int32(1), True, when=jnp.bool_(True))
+    off = wset(arr, jnp.int32(1), True, when=jnp.bool_(False))
+    assert bool(on[1]) and not bool(off[1])
+    assert not np.asarray(off).any()
+
+
+def test_wset_dtype_cast_matches_at():
+    arr = jnp.zeros((4,), jnp.uint32)
+    out = wset(arr, jnp.int32(3), 7)  # python int -> uint32, like .at[].set
+    assert out.dtype == jnp.uint32 and int(out[3]) == 7
+
+
+def test_wset_under_vmap():
+    B, N = 512, 4
+    rng = np.random.default_rng(1)
+    base = jnp.asarray(rng.random((B, N)) < 0.3)
+    idx = jnp.asarray(rng.integers(0, N, B), jnp.int32)
+    ok = jnp.asarray(rng.random(B) < 0.5)
+    got = jax.jit(jax.vmap(lambda b, a, o: wset(b, a, True, when=o)))(
+        base, idx, ok)
+    want = np.array(base)
+    for i in range(B):
+        if ok[i]:
+            want[i, idx[i]] = True
+    np.testing.assert_array_equal(np.asarray(got), want)
