@@ -1,0 +1,79 @@
+"""On-chip determinism check: TPU trajectories must equal CPU leaf-for-leaf.
+
+This is the script that caught the axon-stack batched-scalar-scatter
+miscompile (see scripts/tpu_scatter_bug_repro.py and PERF_NOTES.md): the
+engine was bit-exact at B=64 and silently wrong at B=2048, so ALWAYS run
+this at fleet batch sizes after any engine or stack change.
+
+Usage (tunnel up):
+    python scripts/xplat_parity.py                 # serial B=2048, 2x96 steps
+    python scripts/xplat_parity.py parallel 1024 16 2
+    python scripts/xplat_parity.py serial 16384 64 2
+
+Exit code 0 and {"n_bad": 0} means every state leaf of the TPU run equals
+the CPU run.  Nonzero n_bad prints the first mismatched leaf paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from librabft_simulator_tpu.utils.rlimit import raise_stack_limit
+
+raise_stack_limit()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def main() -> int:
+    from librabft_simulator_tpu.core.types import SimParams
+    from librabft_simulator_tpu.sim import parallel_sim, simulator
+
+    engine_name = sys.argv[1] if len(sys.argv) > 1 else "serial"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 96
+    calls = int(sys.argv[4]) if len(sys.argv) > 4 else 2
+    engine = parallel_sim if engine_name == "parallel" else simulator
+    p = SimParams(n_nodes=4, delay_kind="uniform", max_clock=2**30,
+                  epoch_handoff=False, queue_cap=32)
+
+    def runit(device):
+        with jax.default_device(device):
+            st = engine.init_batch(p, np.arange(batch, dtype=np.uint32))
+            st = simulator.dedupe_buffers(st)
+            run = engine.make_run_fn(p, chunk)
+            for _ in range(calls):
+                st = run(st)
+            return jax.device_get(st)
+
+    tpus = [d for d in jax.devices() if d.platform != "cpu"]
+    if not tpus:
+        print(json.dumps({"error": "no accelerator device visible"}))
+        return 2
+    t = runit(tpus[0])
+    c = runit(jax.devices("cpu")[0])
+    bad = ["/".join(str(q) for q in pt)
+           for (pt, lt), (_, lc) in zip(
+               jax.tree_util.tree_flatten_with_path(t)[0],
+               jax.tree_util.tree_flatten_with_path(c)[0])
+           if not np.array_equal(np.asarray(lt), np.asarray(lc))]
+    print(json.dumps({
+        "engine": engine_name, "instances": batch,
+        "steps": chunk * calls, "n_bad": len(bad), "bad": bad[:10],
+        "commits_tpu": int(np.sum(t.ctx.commit_count)),
+        "commits_cpu": int(np.sum(c.ctx.commit_count)),
+    }))
+    return 0 if not bad else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
