@@ -9,6 +9,11 @@ Usage (tunnel up):
     python scripts/xplat_parity.py                 # serial B=2048, 2x96 steps
     python scripts/xplat_parity.py parallel 1024 16 2
     python scripts/xplat_parity.py serial 16384 64 2
+    # Wide-fleet parallel shapes (the sweep's config-3/5 lowerings: lane
+    # routing + flat inbox scatters at n=16/64 widths):
+    XPLAT_NODES=64 XPLAT_DELAY=pareto XPLAT_DROP=0.05 \
+        python scripts/xplat_parity.py parallel 64 8 2
+    XPLAT_NODES=16 XPLAT_CHAIN=2 python scripts/xplat_parity.py parallel 256 8 2
 
 Exit code 0 and {"n_bad": 0} means every state leaf of the TPU run equals
 the CPU run.  Nonzero n_bad prints the first mismatched leaf paths.
@@ -43,8 +48,13 @@ def main() -> int:
     chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 96
     calls = int(sys.argv[4]) if len(sys.argv) > 4 else 2
     engine = parallel_sim if engine_name == "parallel" else simulator
-    p = SimParams(n_nodes=4, delay_kind="uniform", max_clock=2**30,
-                  epoch_handoff=False, queue_cap=32)
+    n = int(os.environ.get("XPLAT_NODES", "4"))
+    p = SimParams(n_nodes=n,
+                  delay_kind=os.environ.get("XPLAT_DELAY", "uniform"),
+                  drop_prob=float(os.environ.get("XPLAT_DROP", "0")),
+                  commit_chain=int(os.environ.get("XPLAT_CHAIN", "3")),
+                  max_clock=2**30, epoch_handoff=False,
+                  queue_cap=max(32, 4 * n))
 
     def runit(device):
         with jax.default_device(device):
@@ -67,7 +77,7 @@ def main() -> int:
                jax.tree_util.tree_flatten_with_path(c)[0])
            if not np.array_equal(np.asarray(lt), np.asarray(lc))]
     print(json.dumps({
-        "engine": engine_name, "instances": batch,
+        "engine": engine_name, "n_nodes": n, "instances": batch,
         "steps": chunk * calls, "n_bad": len(bad), "bad": bad[:10],
         "commits_tpu": int(np.sum(t.ctx.commit_count)),
         "commits_cpu": int(np.sum(c.ctx.commit_count)),
