@@ -1,0 +1,130 @@
+"""Differential fuzz: jitted serial engine vs the pure-Python oracle.
+
+The framework's core claim is bit-determinism across implementations; the
+test suite pins ~15 hand-picked configs.  This fuzzer covers the runtime-
+parameter space cheaply by exploiting ``SimParams.structural()``
+memoization: delay kind/params, drop_prob, and max_clock are runtime data,
+so HUNDREDS of (delay, drop, horizon, seed) combinations run on a handful
+of XLA compiles.  Structural shapes (n_nodes, window, chain_k,
+commit_chain, handoff) rotate slowly since each costs a fresh compile.
+
+Every trial asserts the full test_parity invariant set: event/clock/stamp/
+message counters, per-node committed chains, store heads, and lock rounds.
+
+Usage: python scripts/fuzz_parity.py [minutes]   # default 30
+Writes FUZZ_PARITY_r05.json {trials, structural_shapes, failures[]}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np  # noqa: E402
+
+from librabft_simulator_tpu.core.types import SimParams  # noqa: E402
+from librabft_simulator_tpu.oracle.sim import OracleSim  # noqa: E402
+from librabft_simulator_tpu.sim import simulator as S  # noqa: E402
+
+# Slow axis: each entry is one XLA compile.  Mix of protocol variants.
+STRUCTURAL = [
+    dict(n_nodes=3),
+    dict(n_nodes=4),
+    dict(n_nodes=4, commit_chain=2),
+    dict(n_nodes=5, window=8, chain_k=2, commit_log=16),
+    dict(n_nodes=4, shuffle_receivers=True),
+    dict(n_nodes=3, commands_per_epoch=60, handoff_epochs=2),
+    dict(n_nodes=6, queue_cap=48),
+]
+
+DELAYS = [
+    dict(delay_kind="lognormal", delay_mean=10.0, delay_variance=4.0),
+    dict(delay_kind="lognormal", delay_mean=25.0, delay_variance=16.0),
+    dict(delay_kind="uniform"),
+    dict(delay_kind="pareto", delay_pareto_scale=5.0, delay_pareto_alpha=1.5),
+    dict(delay_kind="pareto", delay_pareto_scale=2.0, delay_pareto_alpha=2.5),
+    dict(delay_kind="constant"),
+]
+
+
+def committed_chain(st, node, H):
+    cc = int(st.ctx.commit_count[node])
+    return [(int(st.ctx.log_depth[node, i % H]), int(st.ctx.log_tag[node, i % H]))
+            for i in range(max(cc - H, 0), cc)]
+
+
+def one_trial(p: SimParams, seed: int) -> list[str]:
+    st = S.init_state(p, seed)
+    st = S.run_to_completion(p, st)
+    orc = OracleSim(p, seed).run()
+    errs = []
+    for name, a, b in [
+        ("n_events", int(st.n_events), orc.n_events),
+        ("clock", int(st.clock), orc.clock),
+        ("stamp_ctr", int(st.stamp_ctr), orc.stamp_ctr),
+        ("msgs_sent", int(st.n_msgs_sent), orc.n_msgs_sent),
+        ("msgs_dropped", int(st.n_msgs_dropped), orc.n_msgs_dropped),
+        ("queue_full", int(st.n_queue_full), orc.n_queue_full),
+    ]:
+        if a != b:
+            errs.append(f"{name}: jax={a} oracle={b}")
+    H = st.ctx.log_depth.shape[-1]
+    for a in range(p.n_nodes):
+        if committed_chain(st, a, H) != orc.committed_chain(a):
+            errs.append(f"node {a} committed chain differs")
+        if int(st.store.current_round[a]) != orc.stores[a].current_round:
+            errs.append(f"node {a} current_round differs")
+        if int(st.node.locked_round[a]) != orc.nxs[a].locked_round:
+            errs.append(f"node {a} locked_round differs")
+    return errs
+
+
+def main() -> int:
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
+    deadline = time.time() + minutes * 60
+    rng = random.Random(0xF12A)
+    trials = 0
+    shapes_used = set()
+    failures = []
+    while time.time() < deadline:
+        sk = rng.randrange(len(STRUCTURAL))
+        structural = STRUCTURAL[sk]
+        runtime = dict(rng.choice(DELAYS))
+        runtime["drop_prob"] = rng.choice([0.0, 0.0, 0.02, 0.05, 0.15])
+        runtime["max_clock"] = rng.choice([400, 800, 1500])
+        p = SimParams(**structural, **runtime)
+        seed = rng.randrange(2**31)
+        shapes_used.add(sk)
+        errs = one_trial(p, seed)
+        trials += 1
+        if errs:
+            failures.append(dict(structural=structural, runtime=runtime,
+                                 seed=seed, errors=errs))
+            print(json.dumps(failures[-1]), flush=True)
+        if trials % 10 == 0:
+            print(f"[fuzz] {trials} trials, {len(shapes_used)} shapes, "
+                  f"{len(failures)} failures", file=sys.stderr, flush=True)
+    out = dict(trials=trials, structural_shapes=len(shapes_used),
+               failures=failures)
+    with open("FUZZ_PARITY_r05.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "failures"}
+                     | {"n_failures": len(failures)}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
