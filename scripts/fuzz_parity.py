@@ -37,6 +37,7 @@ import numpy as np  # noqa: E402
 
 from librabft_simulator_tpu.core.types import SimParams  # noqa: E402
 from librabft_simulator_tpu.oracle.sim import OracleSim  # noqa: E402
+from librabft_simulator_tpu.sim import byzantine  # noqa: E402
 from librabft_simulator_tpu.sim import simulator as S  # noqa: E402
 
 # Slow axis: each entry is one XLA compile.  Mix of protocol variants.
@@ -66,10 +67,11 @@ def committed_chain(st, node, H):
             for i in range(max(cc - H, 0), cc)]
 
 
-def one_trial(p: SimParams, seed: int) -> list[str]:
-    st = S.init_state(p, seed)
+def one_trial(p: SimParams, seed: int, byz=None) -> list[str]:
+    kw = dict(byz or {})
+    st = S.init_state(p, seed, **{k: np.asarray(v) for k, v in kw.items()})
     st = S.run_to_completion(p, st)
-    orc = OracleSim(p, seed).run()
+    orc = OracleSim(p, seed, **{k: list(v) for k, v in kw.items()}).run()
     errs = []
     for name, a, b in [
         ("n_events", int(st.n_events), orc.n_events),
@@ -89,6 +91,15 @@ def one_trial(p: SimParams, seed: int) -> list[str]:
             errs.append(f"node {a} current_round differs")
         if int(st.node.locked_round[a]) != orc.nxs[a].locked_round:
             errs.append(f"node {a} locked_round differs")
+    # Safety invariant: across honest nodes, one tag per committed depth
+    # (holds for any f <= floor((n-1)/3) attacker mix the sampler draws).
+    # Reuses the suite's reference checker on a batch-of-1 view.
+    byz_any = np.zeros(p.n_nodes, bool)
+    for v in (byz or {}).values():
+        byz_any |= np.asarray(v, bool)
+    st1 = jax.tree.map(lambda x: np.asarray(x)[None], st)
+    if not byzantine.check_safety_reference(st1, honest_mask=~byz_any)[0]:
+        errs.append("SAFETY: honest nodes committed conflicting tags")
     return errs
 
 
@@ -97,6 +108,7 @@ def main() -> int:
     deadline = time.time() + minutes * 60
     rng = random.Random(0xF12A)
     trials = 0
+    byz_trials = {"byz_equivocate": 0, "byz_silent": 0, "byz_forge_qc": 0}
     shapes_used = set()
     failures = []
     while time.time() < deadline:
@@ -108,17 +120,30 @@ def main() -> int:
         p = SimParams(**structural, **runtime)
         seed = rng.randrange(2**31)
         shapes_used.add(sk)
-        errs = one_trial(p, seed)
+        # Byzantine leg (~40% of trials): up to f = floor((n-1)/3) nodes
+        # get a random attacker kind; masks are runtime data (SimState),
+        # so this shares the honest trials' executables.
+        byz = None
+        n = p.n_nodes
+        f_max = (n - 1) // 3
+        if f_max and rng.random() < 0.4:
+            kind = rng.choice(["byz_equivocate", "byz_silent", "byz_forge_qc"])
+            mask = [False] * n
+            for a in rng.sample(range(n), rng.randrange(1, f_max + 1)):
+                mask[a] = True
+            byz = {kind: mask}
+            byz_trials[kind] += 1
+        errs = one_trial(p, seed, byz)
         trials += 1
         if errs:
             failures.append(dict(structural=structural, runtime=runtime,
-                                 seed=seed, errors=errs))
+                                 seed=seed, byz=byz, errors=errs))
             print(json.dumps(failures[-1]), flush=True)
         if trials % 10 == 0:
             print(f"[fuzz] {trials} trials, {len(shapes_used)} shapes, "
                   f"{len(failures)} failures", file=sys.stderr, flush=True)
-    out = dict(trials=trials, structural_shapes=len(shapes_used),
-               failures=failures)
+    out = dict(trials=trials, byz_trials=byz_trials,
+               structural_shapes=len(shapes_used), failures=failures)
     with open("FUZZ_PARITY_r05.json", "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: v for k, v in out.items() if k != "failures"}
