@@ -1,0 +1,179 @@
+"""Packed per-node state planes: the kernel-count fix for TPU.
+
+Round-5 on-chip profiling (PERF_NOTES.md) showed the serial step is
+kernel-count-bound on TPU: one event lowers to ~330 tiny fusions, and a
+large share of them are the per-leaf gathers/selects that read and write
+the ~70 small per-node arrays in ``Store``/``Pacemaker``/``NodeExtra``/
+``Context``.  This module applies the trick that already fixed the queue
+(``types.pack_payload``) to the node state itself: all per-node leaves are
+stored as ONE flat ``[N, S]`` int32 plane with a static slot map, so
+
+* reading a node's state is one row gather (``planes[a]``) followed by
+  free slicing/reshaping/bitcasting (views, fused into consumers), and
+* writing it back is one plane-wide masked select (``xops.wset``) instead
+  of one kernel per leaf.
+
+The packing is bit-preserving (uint32 bitcast, bool as 0/1), so packed and
+unpacked engines produce bit-identical trajectories — pinned by
+``tests/test_packing.py`` and the fuzz campaign.  Handlers keep operating
+on the unpacked single-node struct slices; only the *storage* layout and
+the slice/update boundary change.
+
+``SimParams.packed`` gates the layout: ``None`` (auto) resolves to True
+under TPU lowering and False elsewhere (the round-5 negative results —
+dense full-plane writes are slower on CPU — stay respected).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .types import (
+    Context,
+    NodeExtra,
+    Pacemaker,
+    Queue,
+    SimParams,
+    SimState,
+    Store,
+)
+
+Array = jnp.ndarray
+I32 = jnp.int32
+
+# The four per-node sub-states, in SimState field order.  Their single-node
+# slices are what the handlers in core/store.py, core/node.py, and
+# core/data_sync.py operate on.
+NODE_PARTS = ("store", "pm", "node", "ctx")
+
+
+def node_template(p: SimParams):
+    """Single-node template pytree (shape ``()`` per scalar leaf)."""
+    return (Store.initial(p), Pacemaker.initial(), NodeExtra.initial(),
+            Context.initial(p))
+
+
+@functools.lru_cache(maxsize=None)
+def slot_map(p_structural: SimParams):
+    """Static slot map for one node's packed vector.
+
+    Returns ``(slots, width)`` where ``slots`` is a tuple of
+    ``(offset, size, shape, dtype_name)`` in ``tree_leaves`` order over
+    :func:`node_template` and ``width`` is the total vector length S."""
+    leaves = jax.tree_util.tree_leaves(node_template(p_structural))
+    slots = []
+    off = 0
+    for leaf in leaves:
+        size = int(np.prod(leaf.shape)) if leaf.ndim else 1
+        slots.append((off, size, tuple(leaf.shape), str(leaf.dtype)))
+        off += size
+    return tuple(slots), off
+
+
+def node_width(p: SimParams) -> int:
+    """Packed width S of one node's state."""
+    return slot_map(p.structural())[1]
+
+
+def pack_node(p: SimParams, store, pm, nx, ctx) -> Array:
+    """Pack (Store, Pacemaker, NodeExtra, Context) into ``[..., S]`` int32.
+
+    Leaves may carry arbitrary leading dims (node axis, lane axis, batch
+    axis): only the trailing per-node slice dims are flattened, mirroring
+    ``types.pack_payload``'s bit-preserving dtype rules."""
+    slots, _ = slot_map(p.structural())
+    leaves = jax.tree_util.tree_leaves((store, pm, nx, ctx))
+    parts = []
+    for leaf, (_, size, shape, _dtype) in zip(leaves, slots):
+        leaf = jnp.asarray(leaf)
+        lead = leaf.shape[:leaf.ndim - len(shape)]
+        flat = leaf.reshape(lead + (size,))
+        if flat.dtype == jnp.uint32:
+            flat = jax.lax.bitcast_convert_type(flat, jnp.int32)
+        else:
+            flat = flat.astype(jnp.int32)
+        parts.append(flat)
+    return jnp.concatenate(parts, axis=-1)
+
+
+def unpack_node(p: SimParams, vec: Array):
+    """Inverse of :func:`pack_node` for ``[..., S]`` rows.
+
+    Pure slicing/reshaping/bitcasting — lowers to views that fuse into the
+    consumers, not standalone kernels."""
+    slots, width = slot_map(p.structural())
+    template = node_template(p)
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    lead = vec.shape[:-1]
+    out = []
+    for leaf, (off, size, shape, dtype) in zip(leaves, slots):
+        piece = vec[..., off:off + size]
+        if dtype == "uint32":
+            piece = jax.lax.bitcast_convert_type(piece, jnp.uint32)
+        elif dtype == "bool":
+            piece = piece != 0
+        out.append(piece.reshape(lead + shape))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _common_fields(cls) -> tuple[str, ...]:
+    return tuple(f.name for f in dataclasses.fields(cls)
+                 if f.name not in NODE_PARTS)
+
+
+@struct.dataclass
+class PackedSimState:
+    """``SimState`` with the four per-node sub-states fused into one
+    ``[N, S]`` plane.  Every other field is identical to ``SimState`` (the
+    step function reads them by name, so both layouts share one code
+    path)."""
+
+    planes: Array         # [N, S] packed (store, pm, node, ctx) rows
+    queue: Queue
+    ho_pay: Array
+    ho_epoch: Array
+    timer_time: Array
+    timer_stamp: Array
+    startup: Array
+    weights: Array
+    byz_equivocate: Array
+    byz_silent: Array
+    byz_forge_qc: Array
+    clock: Array
+    stamp_ctr: Array
+    halted: Array
+    seed: Array
+    max_clock: Array
+    drop_u32: Array
+    n_events: Array
+    n_msgs_sent: Array
+    n_msgs_dropped: Array
+    n_queue_full: Array
+    trace_node: Array
+    trace_round: Array
+    trace_time: Array
+    trace_count: Array
+
+
+_SIM_COMMON = _common_fields(SimState)
+
+
+def pack_state(p: SimParams, st: SimState) -> PackedSimState:
+    """SimState -> PackedSimState (leading batch dims supported)."""
+    planes = pack_node(p, st.store, st.pm, st.node, st.ctx)
+    return PackedSimState(
+        planes=planes, **{f: getattr(st, f) for f in _SIM_COMMON})
+
+
+def unpack_state(p: SimParams, pst: PackedSimState) -> SimState:
+    """PackedSimState -> SimState (exact inverse of :func:`pack_state`)."""
+    store, pm, nx, ctx = unpack_node(p, pst.planes)
+    return SimState(
+        store=store, pm=pm, node=nx, ctx=ctx,
+        **{f: getattr(pst, f) for f in _SIM_COMMON})

@@ -106,6 +106,33 @@ class SimParams:
     # those whiles are ~half the on-chip step time.  Trajectories are
     # bit-identical either way (tests/test_parity.py::test_unroll_parity).
     unroll: bool = False
+    # Packed state planes (core/packing.py): store the ~70 per-node
+    # Store/Pacemaker/NodeExtra/Context leaves as one flat [N, S] int32
+    # plane, so the step's node read is one row gather and its write-back
+    # one plane-wide select instead of one kernel per leaf.  Bit-identical
+    # either way (tests/test_packing.py).  None = auto: True under TPU
+    # lowering, False elsewhere (full-plane writes lose on CPU — the
+    # round-5 negative results).  Resolved by sim engines at make-time via
+    # utils/xops.resolve_params.
+    packed: bool | None = None
+    # Lowering form for the step's vector scatters (the 7 queue writes):
+    # "scatter" = proven .at[].set(mode="drop") forms (CPU default),
+    # "dense" = one-hot sum-select / matmul forms (TPU default: scatters
+    # serialize into per-kernel dispatch there).  "auto" resolves by
+    # backend at make-time (utils/xops.backend_mode; LIBRABFT_WRITE_MODE
+    # env overrides for A/B benching).  All forms bit-identical
+    # (tests/test_xops.py).
+    dense_writes: str = "auto"
+    # Short-circuit handle_notification/handle_response behind the event-
+    # kind predicates with lax.cond.  Unbatched lowerings (oracle-parity
+    # runs, B=1) genuinely skip the wrong-kind subgraph; batched lowerings
+    # select between branches exactly as the previous per-field _sel did,
+    # so trajectories are bit-identical either way.  None = auto: True
+    # under TPU lowering only — on CPU the conditional's extra branch
+    # computations slow XLA *compiles* enough to cost tier-1 test-budget
+    # dots (measured: 35 vs 39 in the 870 s gate), outweighing its ~10%
+    # batched-runtime win, so the CPU graph stays exactly the pre-PR one.
+    gate_handlers: bool | None = None
     # Network.
     shuffle_receivers: bool = False  # seeded per-event receiver permutation
                                      # (simulator.rs:343 fuzzing semantics);

@@ -67,7 +67,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from ..core import data_sync, node as node_ops, store as store_ops
+from ..core import data_sync, node as node_ops, packing, store as store_ops
 from .simulator import _forged_qc_payload
 from ..core.types import (
     KIND_NOTIFY,
@@ -86,6 +86,7 @@ from ..core.types import (
     unpack_payload,
 )
 from ..utils import hashing as H
+from ..utils import xops
 from ..utils.xops import wset
 from ..utils.quantile import TABLE_BITS
 
@@ -144,6 +145,61 @@ class PSimState:
     trace_round: jnp.ndarray
     trace_time: jnp.ndarray
     trace_count: jnp.ndarray
+
+
+@struct.dataclass
+class PackedPSimState:
+    """``PSimState`` with the four per-node sub-states fused into one
+    ``[N, S]`` plane (core/packing.py).  Every other field matches
+    ``PSimState`` by name, so the step shares one code path."""
+
+    planes: jnp.ndarray      # [N, S] packed (store, pm, node, ctx) rows
+    byz_forge_qc: jnp.ndarray
+    max_clock: jnp.ndarray
+    drop_u32: jnp.ndarray
+    ho_pay: jnp.ndarray
+    ho_epoch: jnp.ndarray
+    in_valid: jnp.ndarray
+    in_time: jnp.ndarray
+    in_kind: jnp.ndarray
+    in_stamp: jnp.ndarray
+    in_sender: jnp.ndarray
+    in_pay: jnp.ndarray
+    timer_time: jnp.ndarray
+    startup: jnp.ndarray
+    weights: jnp.ndarray
+    byz_equivocate: jnp.ndarray
+    byz_silent: jnp.ndarray
+    clock: jnp.ndarray
+    node_ctr: jnp.ndarray
+    halted: jnp.ndarray
+    seed: jnp.ndarray
+    n_events: jnp.ndarray
+    n_msgs_sent: jnp.ndarray
+    n_msgs_dropped: jnp.ndarray
+    n_inbox_full: jnp.ndarray
+    trace_node: jnp.ndarray
+    trace_round: jnp.ndarray
+    trace_time: jnp.ndarray
+    trace_count: jnp.ndarray
+
+
+_PSIM_COMMON = packing._common_fields(PSimState)
+
+
+def pack_pstate(p: SimParams, st: PSimState) -> PackedPSimState:
+    """PSimState -> PackedPSimState (leading batch dims supported)."""
+    planes = packing.pack_node(p, st.store, st.pm, st.node, st.ctx)
+    return PackedPSimState(
+        planes=planes, **{f: getattr(st, f) for f in _PSIM_COMMON})
+
+
+def unpack_pstate(p: SimParams, pst: PackedPSimState) -> PSimState:
+    """Exact inverse of :func:`pack_pstate`."""
+    store, pm, nx, ctx = packing.unpack_node(p, pst.planes)
+    return PSimState(
+        store=store, pm=pm, node=nx, ctx=ctx,
+        **{f: getattr(pst, f) for f in _PSIM_COMMON})
 
 
 def d_min_of(p: SimParams) -> int:
@@ -458,10 +514,18 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
               ev_n, drop_n, tr_n, tr_r, tr_t, tr_c)
         return c2, (go, kinds, recvs, stamps, arrive, pay_sel, banks)
 
-    slicer = lambda x: x[sel]  # noqa: E731
+    if p.packed:
+        # One [A, S] row gather + free slicing replaces ~70 per-leaf
+        # gathers (core/packing.py).
+        l_store, l_pm, l_nx, l_cx = packing.unpack_node(p, st.planes[sel])
+    else:
+        slicer = lambda x: x[sel]  # noqa: E731
+        l_store = jax.tree.map(slicer, st.store)
+        l_pm = jax.tree.map(slicer, st.pm)
+        l_nx = jax.tree.map(slicer, st.node)
+        l_cx = jax.tree.map(slicer, st.ctx)
     carry0 = (
-        jax.tree.map(slicer, st.store), jax.tree.map(slicer, st.pm),
-        jax.tree.map(slicer, st.node), jax.tree.map(slicer, st.ctx),
+        l_store, l_pm, l_nx, l_cx,
         st.in_valid[sel], st.timer_time[sel], st.node_ctr[sel],
         st.ho_pay[sel], st.ho_epoch[sel], _i32(0), _i32(0),
         st.trace_node, st.trace_round, st.trace_time, st.trace_count)
@@ -473,10 +537,18 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     # ---- Scatter lane state back (sel indices are distinct; inactive lanes
     # carried their original values, so unconditional writes are no-ops).
     put = lambda x, v: x.at[sel].set(v)  # noqa: E731
-    store2 = jax.tree.map(put, st.store, g_store)
-    pm2 = jax.tree.map(put, st.pm, g_pm)
-    nx2 = jax.tree.map(put, st.node, g_nx)
-    cx2 = jax.tree.map(put, st.ctx, g_cx)
+    if p.packed:
+        # One [A, S] row scatter replaces ~70 per-leaf scatters (vector row
+        # scatters are the proven-safe class, PERF_NOTES.md).
+        node_updates = dict(planes=put(
+            st.planes, packing.pack_node(p, g_store, g_pm, g_nx, g_cx)))
+    else:
+        node_updates = dict(
+            store=jax.tree.map(put, st.store, g_store),
+            pm=jax.tree.map(put, st.pm, g_pm),
+            node=jax.tree.map(put, st.node, g_nx),
+            ctx=jax.tree.map(put, st.ctx, g_cx),
+        )
     in_valid = put(st.in_valid, g_iv)
     timer_time = put(st.timer_time, g_timer)
     node_ctr = put(st.node_ctr, g_ctr)
@@ -537,7 +609,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     delivered = jnp.sum(place_m)
 
     return st.replace(
-        store=store2, pm=pm2, node=nx2, ctx=cx2,
+        **node_updates,
         ho_pay=ho_pay, ho_epoch=ho_epoch,
         in_valid=in_valid2, in_time=in_time2, in_kind=in_kind2,
         in_stamp=in_stamp2, in_sender=in_sender2, in_pay=in_pay2,
@@ -569,11 +641,18 @@ def _equivocate(p: SimParams, pay):
 
 @functools.lru_cache(maxsize=None)
 def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
+    packed = bool(p_structural.packed)
+
     def run(delay_table, dur_table, d_min, st):
+        if packed:
+            st = pack_pstate(p_structural, st)
+
         def body(s, _):
             return step(p_structural, delay_table, dur_table, d_min, s), ()
 
         st, _ = jax.lax.scan(body, st, None, length=num_steps)
+        if packed:
+            st = unpack_pstate(p_structural, st)
         return st
 
     if batched:
@@ -594,6 +673,7 @@ def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
     runtime scalar, so delay/drop/horizon variants share one compile."""
     dmin = d_min_of(p) if d_min is None else d_min
     assert 1 <= dmin <= d_min_of(p), (dmin, d_min_of(p))
+    p = xops.resolve_params(p)
     inner = _compiled_run(p.structural(), num_steps, batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())

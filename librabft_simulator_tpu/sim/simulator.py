@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import data_sync, node as node_ops, store as store_ops
+from ..core import data_sync, node as node_ops, packing, store as store_ops
 from ..core.types import (
     KIND_NOTIFY,
     KIND_REQUEST,
@@ -48,7 +48,8 @@ from ..core.types import (
     unpack_payload,
 )
 from ..utils import hashing as H
-from ..utils.xops import wset
+from ..utils import xops
+from ..utils.xops import scatter_set, wset
 from ..utils.quantile import TABLE_BITS
 
 I32 = jnp.int32
@@ -212,22 +213,44 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     queue = st.queue.replace(
         valid=wset(st.queue.valid, midx, False, when=live & ~is_timer))
 
-    # ---- Node slices.
-    s_a = _node_slice(st.store, a)
-    pm_a = _node_slice(st.pm, a)
-    nx_a = _node_slice(st.node, a)
-    cx_a = _node_slice(st.ctx, a)
+    # ---- Node slices.  Packed layout: one row gather + free slicing
+    # (core/packing.py) instead of ~70 per-leaf gathers.
+    if p.packed:
+        s_a, pm_a, nx_a, cx_a = packing.unpack_node(p, st.planes[a])
+    else:
+        s_a = _node_slice(st.store, a)
+        pm_a = _node_slice(st.pm, a)
+        nx_a = _node_slice(st.node, a)
+        cx_a = _node_slice(st.ctx, a)
     local_clock = clock - st.startup[a]
 
-    # ---- Handlers (all computed, masked by kind; vmap would de-branch
-    # lax.switch anyway).
+    # ---- Handlers, masked by kind.
     is_notify = live & ~is_timer & (kind == KIND_NOTIFY)
     is_request = live & ~is_timer & (kind == KIND_REQUEST)
     is_response = live & ~is_timer & (kind == KIND_RESPONSE)
     do_update = live & (is_timer | is_notify | is_response)
 
-    s_n, should_sync = data_sync.handle_notification(p, s_a, st.weights, pay_in)
-    s_r, nx_r, cx_r = data_sync.handle_response(p, s_a, nx_a, cx_a, st.weights, pay_in)
+    if p.gate_handlers:
+        # lax.cond short-circuits the payload handlers behind the kind
+        # predicates: unbatched lowerings skip the wrong-kind subgraph
+        # entirely (the 16.6 ms handle_response graph runs for the ~5% of
+        # events that are responses); vmapped lowerings de-branch to the
+        # same per-leaf select the explicit _sel form used, so the
+        # trajectory is bit-identical either way.
+        s_n, should_sync = jax.lax.cond(
+            is_notify,
+            lambda: data_sync.handle_notification(p, s_a, st.weights, pay_in),
+            lambda: (s_a, jnp.bool_(False)))
+        s_r, nx_r, cx_r = jax.lax.cond(
+            is_response,
+            lambda: data_sync.handle_response(
+                p, s_a, nx_a, cx_a, st.weights, pay_in),
+            lambda: (s_a, nx_a, cx_a))
+    else:
+        s_n, should_sync = data_sync.handle_notification(
+            p, s_a, st.weights, pay_in)
+        s_r, nx_r, cx_r = data_sync.handle_response(
+            p, s_a, nx_a, cx_a, st.weights, pay_in)
     s_in = store_ops._sel(is_notify, s_n, store_ops._sel(is_response, s_r, s_a))
     nx_in = store_ops._sel(is_response, nx_r, nx_a)
     cx_in = store_ops._sel(is_response, cx_r, cx_a)
@@ -348,14 +371,19 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     tgt = jnp.where(go & ~overflow, slot_of_rank[jnp.clip(rank, 0, 2 * n)], _i32(cm))
 
     out_pay = payload_bank[pay_sel]  # [2n+1, F]
+    # The 7 queue writes: .at[].set scatters on CPU (XLA executes them in
+    # place after fusion), one-hot sum-selects under TPU lowering (scatters
+    # serialize into per-kernel dispatch there; the payload form is a
+    # matmul).  Bit-identical forms — see utils/xops.scatter_set.
+    wmode = xops.backend_mode(p.dense_writes)
     queue = queue.replace(
-        valid=queue.valid.at[tgt].set(True, mode="drop"),
-        time=queue.time.at[tgt].set(arrive, mode="drop"),
-        kind=queue.kind.at[tgt].set(kinds, mode="drop"),
-        stamp=queue.stamp.at[tgt].set(stamps, mode="drop"),
-        sender=queue.sender.at[tgt].set(a, mode="drop"),
-        receiver=queue.receiver.at[tgt].set(recvs, mode="drop"),
-        payload=queue.payload.at[tgt].set(out_pay, mode="drop"),
+        valid=scatter_set(queue.valid, tgt, True, mode=wmode),
+        time=scatter_set(queue.time, tgt, arrive, mode=wmode),
+        kind=scatter_set(queue.kind, tgt, kinds, mode=wmode),
+        stamp=scatter_set(queue.stamp, tgt, stamps, mode=wmode),
+        sender=scatter_set(queue.sender, tgt, a, mode=wmode),
+        receiver=scatter_set(queue.receiver, tgt, recvs, mode=wmode),
+        payload=scatter_set(queue.payload, tgt, out_pay, mode=wmode),
     )
 
     # ---- Timer reschedule (process_node_actions, simulator.rs:310-324).
@@ -381,11 +409,19 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         trace_node, trace_round, trace_time = (
             st.trace_node, st.trace_round, st.trace_time)
 
+    if p.packed:
+        # One plane-wide masked select replaces ~70 per-leaf writes.
+        node_updates = dict(planes=wset(
+            st.planes, a, packing.pack_node(p, s_f, pm_f, nx_f, cx_f)))
+    else:
+        node_updates = dict(
+            store=_node_update(st.store, a, s_f),
+            pm=_node_update(st.pm, a, pm_f),
+            node=_node_update(st.node, a, nx_f),
+            ctx=_node_update(st.ctx, a, cx_f),
+        )
     return st.replace(
-        store=_node_update(st.store, a, s_f),
-        pm=_node_update(st.pm, a, pm_f),
-        node=_node_update(st.node, a, nx_f),
-        ctx=_node_update(st.ctx, a, cx_f),
+        **node_updates,
         queue=queue,
         ho_pay=ho_pay,
         ho_epoch=ho_epoch,
@@ -410,13 +446,23 @@ def _compiled_step(p_structural: SimParams, batched: bool):
     f = functools.partial(step, p_structural)
     if batched:
         f = jax.vmap(f, in_axes=(None, None, 0))
+    if p_structural.packed:
+        # Callers keep the SimState API: the packed layout lives inside the
+        # executable (pack on entry, unpack on exit — exact round-trip, so
+        # chunked runs compose bit-identically with the unpacked engine).
+        def g(dt, du, st):
+            pst = f(dt, du, packing.pack_state(p_structural, st))
+            return packing.unpack_state(p_structural, pst)
+    else:
+        g = f
     # Tables are arguments (not baked constants): one executable serves every
     # delay/drop/max_clock config with this structural shape.
-    return jax.jit(lambda dt, du, st: f(dt, du, st), donate_argnums=(2,))
+    return jax.jit(lambda dt, du, st: g(dt, du, st), donate_argnums=(2,))
 
 
 def make_step_fn(p: SimParams, batched: bool = True):
     """Compiled step over a [B, ...] batch of instances."""
+    p = xops.resolve_params(p)
     inner = _compiled_step(p.structural(), batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
@@ -425,19 +471,35 @@ def make_step_fn(p: SimParams, batched: bool = True):
 
 def step_fn_partial(p: SimParams):
     """Uncompiled single-instance step with tables bound (for callers that
-    wrap it in their own transforms)."""
+    wrap it in their own transforms).  Resolves the 'auto' lowering fields
+    like make_step_fn/make_run_fn, so all three entry points build the
+    same graph from the same params — including the SimState-in/
+    SimState-out contract when ``packed`` resolves on (pack/unpack wrap
+    the step exactly as _compiled_step does)."""
+    p = xops.resolve_params(p)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
-    return functools.partial(step, p, delay_table, dur_table)
+    f = functools.partial(step, p, delay_table, dur_table)
+    if p.packed:
+        return lambda st: packing.unpack_state(
+            p, f(packing.pack_state(p, st)))
+    return f
 
 
 @functools.lru_cache(maxsize=None)
 def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
+    packed = bool(p_structural.packed)
+
     def run(delay_table, dur_table, st):
+        if packed:
+            st = packing.pack_state(p_structural, st)
+
         def body(s, _):
             return step(p_structural, delay_table, dur_table, s), ()
 
         st, _ = jax.lax.scan(body, st, None, length=num_steps)
+        if packed:
+            st = packing.unpack_state(p_structural, st)
         return st
 
     if batched:
@@ -449,7 +511,10 @@ def make_run_fn(p: SimParams, num_steps: int, batched: bool = True):
     """lax.scan of ``num_steps`` events per instance (loop_until).
 
     The jitted executable is memoized on ``p.structural()`` — calls for
-    params differing only in delay/drop/horizon reuse one compile."""
+    params differing only in delay/drop/horizon reuse one compile.  The
+    'auto' lowering fields (packed planes, dense writes) are resolved
+    against the active backend here, before memoization."""
+    p = xops.resolve_params(p)
     inner = _compiled_run(p.structural(), num_steps, batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())

@@ -23,7 +23,165 @@ their indices where a write must always land.
 
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# Backend dispatch: pick lowering *forms*, not semantics.
+#
+# Round-5 full-step timings proved the dense one-hot forms LOSE on CPU
+# (XLA CPU executes the original scatters in place after fusion; dense pays
+# full-plane writes: 74 -> 104-124 ms) while the same shapes are right for
+# TPU (scatters serialize into per-kernel dispatch there; the payload
+# sum-select is matmul-shaped).  ``backend_mode`` resolves which form a
+# write site lowers to; every form is bit-identical (tests/test_xops.py),
+# so this is purely a lowering decision.
+# ---------------------------------------------------------------------------
+
+#: Environment override for A/B benching without touching SimParams.
+MODE_ENV = "LIBRABFT_WRITE_MODE"
+
+_VALID_MODES = ("scatter", "dense")
+
+
+def backend_mode(override: str = "auto") -> str:
+    """Resolve the write-form mode: ``"scatter"`` (proven ``.at[]`` forms,
+    the CPU default) or ``"dense"`` (one-hot sum-select, the TPU default).
+
+    Priority: explicit ``override`` (a ``SimParams`` field) > ``MODE_ENV``
+    env var > ``jax.default_backend()``.  Resolve BEFORE memoizing a
+    compiled step on ``SimParams.structural()`` so the cached executable
+    matches the mode it was traced with."""
+    if override != "auto":
+        mode = override
+    else:
+        mode = os.environ.get(MODE_ENV, "").strip() or (
+            "dense" if jax.default_backend() == "tpu" else "scatter")
+    if mode not in _VALID_MODES:
+        raise ValueError(f"unknown write mode {mode!r}; want one of "
+                         f"{_VALID_MODES} or 'auto'")
+    return mode
+
+
+#: Environment override for the packed-plane layout (0/1); see
+#: ``SimParams.packed``.
+PACKED_ENV = "LIBRABFT_PACKED"
+
+
+def _bool_env(name: str) -> bool | None:
+    """Strict boolean env parse; unrecognized values raise instead of
+    silently enabling (LIBRABFT_PACKED=off must not mean 'on')."""
+    env = os.environ.get(name, "").strip().lower()
+    if not env:
+        return None
+    if env in ("1", "true", "yes", "on"):
+        return True
+    if env in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(f"{name}={env!r}: want one of 1/0, true/false, "
+                     f"yes/no, on/off")
+
+
+def packed_mode(override=None) -> bool:
+    """Resolve the packed-plane layout flag: explicit ``SimParams.packed``
+    > ``PACKED_ENV`` env var > backend default (True on TPU)."""
+    if override is not None:
+        return bool(override)
+    env = _bool_env(PACKED_ENV)
+    if env is not None:
+        return env
+    return jax.default_backend() == "tpu"
+
+
+#: Environment override for handler gating (0/1); see
+#: ``SimParams.gate_handlers``.
+GATE_ENV = "LIBRABFT_GATE_HANDLERS"
+
+
+def gate_mode(override=None) -> bool:
+    """Resolve the handler-gating flag: explicit ``SimParams.gate_handlers``
+    > ``GATE_ENV`` env var > backend default (True on TPU only — the CPU
+    graph stays exactly the pre-PR lowering)."""
+    if override is not None:
+        return bool(override)
+    env = _bool_env(GATE_ENV)
+    if env is not None:
+        return env
+    return jax.default_backend() == "tpu"
+
+
+def resolve_params(p):
+    """Resolve the 'auto' lowering fields of a SimParams (``dense_writes``,
+    ``packed``, ``gate_handlers``) against the active backend.  Engines call
+    this at make-time, BEFORE ``structural()`` memoization, so every cached
+    executable is keyed by the concrete forms it was traced with."""
+    import dataclasses
+
+    mode = backend_mode(p.dense_writes)
+    packed = packed_mode(p.packed)
+    gate = gate_mode(p.gate_handlers)
+    if (mode == p.dense_writes and packed == p.packed
+            and gate == p.gate_handlers):
+        return p
+    return dataclasses.replace(p, dense_writes=mode, packed=packed,
+                               gate_handlers=gate)
+
+
+def scatter_set(dst, idx, src, *, mode: str = "scatter"):
+    """``dst.at[idx].set(src, mode="drop")`` over dim 0, in the requested
+    lowering form.
+
+    ``dst``: ``[M, ...]``; ``idx``: ``[K]`` int targets.  Both forms follow
+    ``.at[]``'s index semantics exactly: values in ``[-M, 0)`` wrap, and
+    anything else out of ``[0, M)`` — notably the sentinel ``idx == M``
+    the queue's overflow path uses — writes nothing.  ``src``: scalar,
+    ``[K]``, or ``[K, ...]`` rows.  Duplicate targets resolve last-wins in
+    both forms (XLA CPU applies scatter updates in order; the dense form
+    selects the highest matching source index).
+
+    ``mode="dense"`` lowers to a one-hot select: a ``[M, K]`` hit matrix,
+    a per-row winner, and a sum-select (matmul-shaped for row payloads) —
+    no scatter kernel boundary, the form TPU wants.
+    """
+    if mode == "scatter":
+        return dst.at[idx].set(src, mode="drop")
+    m, k = dst.shape[0], idx.shape[0]
+    idx = jnp.asarray(idx, jnp.int32)
+    idx = jnp.where(idx < 0, idx + m, idx)  # .at[]'s negative-index wrap
+    src = jnp.broadcast_to(jnp.asarray(src, dst.dtype), (k,) + dst.shape[1:])
+    hit = idx[None, :] == jnp.arange(m, dtype=jnp.int32)[:, None]  # [M, K]
+    # Last matching source wins (mirrors in-order scatter application).
+    winner = jnp.max(jnp.where(hit, jnp.arange(k, dtype=jnp.int32)[None, :],
+                               -1), axis=1)                        # [M]
+    placed = winner >= 0
+    onehot = (jnp.arange(k, dtype=jnp.int32)[None, :] == winner[:, None])
+    if src.ndim == 2:
+        # Row payloads: integer dot keeps it bit-exact; the one-hot matmul
+        # is the MXU-shaped payload select from PERF_NOTES.md.
+        val = jax.lax.dot_general(
+            onehot.astype(jnp.int32),
+            src.astype(jnp.int32) if src.dtype != jnp.int32 else src,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(dst.dtype)
+        return jnp.where(placed[:, None], val, dst)
+    if src.ndim > 2:
+        # General trailing dims: per-row winner gather + masked select.
+        # Not a current engine shape (queue leaves are [CM] / [CM, F]);
+        # kept total so the dense form never works-on-CPU-only.
+        val = src[jnp.maximum(winner, 0)]
+        mask = placed.reshape((m,) + (1,) * (dst.ndim - 1))
+        return jnp.where(mask, val, dst)
+    if dst.dtype == jnp.bool_:
+        val = jnp.sum(jnp.where(onehot, src[None, :].astype(jnp.int32), 0),
+                      axis=1) != 0
+    else:
+        val = jnp.sum(jnp.where(onehot, src[None, :],
+                                jnp.zeros((), dst.dtype)),
+                      axis=1, dtype=dst.dtype)
+    return jnp.where(placed, val, dst)
 
 
 def wset(arr, idx, val, when=None):

@@ -12,7 +12,9 @@ Every trial asserts the full test_parity invariant set: event/clock/stamp/
 message counters, per-node committed chains, store heads, and lock rounds.
 
 Usage: python scripts/fuzz_parity.py [minutes]   # default 30
-Writes FUZZ_PARITY_r05.json {trials, structural_shapes, failures[]}.
+    FUZZ_PACKED=1 python scripts/fuzz_parity.py 10   # packed-plane engine
+Writes FUZZ_PARITY_r05.json (FUZZ_PARITY_r06_packed.json under
+FUZZ_PACKED=1) {trials, structural_shapes, failures[]}.
 """
 
 from __future__ import annotations
@@ -50,6 +52,14 @@ STRUCTURAL = [
     dict(n_nodes=3, commands_per_epoch=60, handoff_epochs=2),
     dict(n_nodes=6, queue_cap=48),
 ]
+
+# FUZZ_PACKED=1 runs every trial on the packed-plane engine
+# (core/packing.py) — the jitted side packs state into [N, S] planes while
+# the oracle stays leaf-based, so any packing defect shows as a parity
+# divergence.  Strict parse (xops._bool_env): "off" must not mean on.
+from librabft_simulator_tpu.utils import xops  # noqa: E402
+
+PACKED = xops._bool_env("FUZZ_PACKED") or False
 
 DELAYS = [
     dict(delay_kind="lognormal", delay_mean=10.0, delay_variance=4.0),
@@ -117,7 +127,7 @@ def main() -> int:
         runtime = dict(rng.choice(DELAYS))
         runtime["drop_prob"] = rng.choice([0.0, 0.0, 0.02, 0.05, 0.15])
         runtime["max_clock"] = rng.choice([400, 800, 1500])
-        p = SimParams(**structural, **runtime)
+        p = SimParams(**structural, **runtime, packed=PACKED)
         seed = rng.randrange(2**31)
         shapes_used.add(sk)
         # Byzantine leg (~40% of trials): up to f = floor((n-1)/3) nodes
@@ -142,9 +152,10 @@ def main() -> int:
         if trials % 10 == 0:
             print(f"[fuzz] {trials} trials, {len(shapes_used)} shapes, "
                   f"{len(failures)} failures", file=sys.stderr, flush=True)
-    out = dict(trials=trials, byz_trials=byz_trials,
+    out = dict(trials=trials, byz_trials=byz_trials, packed=PACKED,
                structural_shapes=len(shapes_used), failures=failures)
-    with open("FUZZ_PARITY_r05.json", "w") as f:
+    with open("FUZZ_PARITY_r06_packed.json" if PACKED
+              else "FUZZ_PARITY_r05.json", "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: v for k, v in out.items() if k != "failures"}
                      | {"n_failures": len(failures)}))

@@ -1,0 +1,190 @@
+"""Compile-time kernel/fusion census for the jitted serial step.
+
+Round-5 on-chip profiling showed the step is kernel-count-bound on TPU
+(~330 tiny fusions per step; per-kernel dispatch, not FLOPs, sets the
+ceiling).  This script makes that number a compile-time regression metric
+that does NOT need the TPU tunnel: it lowers the jitted serial step via
+``jax.jit(...).lower(...).compile()`` and counts instructions by opcode in
+the optimized HLO — fusions being the headline (each fusion is one kernel
+launch; unfused whiles/scatters/sorts add their own dispatches).
+
+Three graphs are censused:
+
+* ``baseline_pre_pr`` — the exact pre-PR lowering (unpacked leaves,
+  scatter queue writes, ungated handlers), reproducible forever from the
+  current tree, so the "before" number never goes stale;
+* ``cpu_default``      — what CPU lowering runs after this PR (proven
+  scatter forms kept; only handler gating differs from baseline);
+* ``tpu_shape``        — what TPU lowering runs after this PR (packed
+  state planes + dense one-hot queue writes + handler gating).
+
+On a CPU-only host the counts are a *proxy* for the TPU lowering (XLA's
+fusion decisions differ per backend, but the op-count structure the
+backends fuse from is the same graph); rerun on chip when the tunnel is
+up.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/kernel_census.py
+    python scripts/kernel_census.py --assert-max 250   # CI regression gate
+    python scripts/kernel_census.py --n 4 --batch 2048 --out CENSUS.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import dataclasses
+import functools
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from librabft_simulator_tpu.core import packing  # noqa: E402
+from librabft_simulator_tpu.core.types import SimParams  # noqa: E402
+from librabft_simulator_tpu.sim import simulator as S  # noqa: E402
+
+# Computation header: "%name (params) -> type {" (optionally "ENTRY ...").
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.-]+)\s*(\([^)]*\))?\s*->.*{")
+# Opcode(s) on an instruction line: "%name = type opcode(...)".
+_OP_RE = re.compile(r"=\s[^=]*?\s([\w-]+)\(")
+
+# Ops that launch (or serialize into) their own kernel(s) when not fused.
+_DISPATCH_OPS = ("fusion", "scatter", "sort", "dot", "custom-call", "rng",
+                 "while", "conditional", "all-reduce", "all-gather")
+
+
+def hlo_counts(txt: str) -> dict:
+    """Count ops per computation in optimized HLO text.
+
+    The headline metric is ``top_fusions``: fusion calls in the entry
+    computation plus while-loop bodies — i.e. fusions actually dispatched
+    per step (XLA CPU also *nests* fusions inside fusion bodies; those are
+    inlined by the emitter, not separate launches, so raw fusion-instruction
+    totals overcount ~3x).  At n=4/B=2048 the pre-PR ``top_dispatch`` count
+    (334) matches the ~330 per-step kernels the round-5 on-chip profiler
+    saw, which is what qualifies this as the kernel-count proxy."""
+    comp = None
+    per = collections.Counter()
+    for line in txt.splitlines():
+        m = _COMP_RE.match(line)
+        if m:
+            comp = ("ENTRY:" if m.group(1) else "") + m.group(2)
+            continue
+        for op in _OP_RE.findall(line):
+            per[(comp or "?", op)] += 1
+    entry = next((c for c, _ in per if c.startswith("ENTRY:")), None)
+    while_bodies = set(re.findall(r"while\(.*?\).*?body=%?([\w.-]+)", txt))
+
+    def top(pred):
+        return sum(v for (c, op), v in per.items()
+                   if (c == entry or c.split(":")[-1] in while_bodies)
+                   and pred(op))
+
+    ops = collections.Counter()
+    for (_, op), v in per.items():
+        ops[op] += v
+    return {
+        "top_fusions": top(lambda op: op == "fusion"),
+        "top_dispatch": top(lambda op: op in _DISPATCH_OPS),
+        "total_fusions": ops.get("fusion", 0),
+        "instructions": sum(ops.values()),
+        "whiles": ops.get("while", 0),
+        "scatters": ops.get("scatter", 0),
+        "conditionals": ops.get("conditional", 0),
+    }
+
+
+def census_step(p: SimParams, batch: int) -> dict:
+    """Lower + compile the jitted vmapped serial step; count HLO ops.
+
+    For packed params the step is lowered on the packed plane state (the
+    steady-state scan body), not the pack/unpack boundary."""
+    st = S.init_batch(p, np.arange(batch, dtype=np.uint32))
+    if p.packed:
+        st = packing.pack_state(p, st)
+    dt = jnp.asarray(p.delay_table())
+    du = jnp.asarray(p.duration_table())
+    f = jax.jit(jax.vmap(functools.partial(S.step, p),
+                         in_axes=(None, None, 0)))
+    compiled = f.lower(dt, du, st).compile()
+    return hlo_counts(compiled.as_text())
+
+
+MODES = {
+    # The pre-PR serial-step graph, exactly: per-leaf node state,
+    # .at[] queue scatters, handlers computed unconditionally.
+    "baseline_pre_pr": dict(packed=False, dense_writes="scatter",
+                            gate_handlers=False),
+    # Post-PR CPU default (xops.resolve_params on a CPU backend) — by
+    # design the exact pre-PR graph: every TPU form is gated off on CPU.
+    "cpu_default": dict(packed=False, dense_writes="scatter",
+                        gate_handlers=False),
+    # Post-PR TPU lowering shape (xops.resolve_params on a TPU backend).
+    "tpu_shape": dict(packed=True, dense_writes="dense",
+                      gate_handlers=True),
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--unroll", action="store_true",
+                    help="census the unrolled-scan variants too")
+    ap.add_argument("--assert-max", type=int, default=None,
+                    help="exit nonzero if the tpu_shape fusion count "
+                         "exceeds this budget (CI regression gate)")
+    ap.add_argument("--out", default=None,
+                    help="write the full census JSON here")
+    args = ap.parse_args()
+
+    base = SimParams(n_nodes=args.n, delay_kind="uniform", max_clock=2**30,
+                     queue_cap=max(32, 4 * args.n), unroll=args.unroll)
+    out = {
+        "platform": jax.default_backend(),
+        "config": {"n_nodes": args.n, "batch": args.batch,
+                   "queue_cap": base.queue_cap, "unroll": args.unroll},
+        "modes": {},
+    }
+    seen = {}  # identical mode dicts share one compile (cpu_default is
+    # baseline_pre_pr by construction; compiling it twice buys nothing)
+    for name, kw in MODES.items():
+        key = tuple(sorted(kw.items()))
+        if key not in seen:
+            p = dataclasses.replace(base, **kw)
+            seen[key] = census_step(p, args.batch)
+        out["modes"][name] = c = seen[key]
+        print(f"{name:18s} top_fusions={c['top_fusions']:4d} "
+              f"top_dispatch={c['top_dispatch']:4d} "
+              f"total_fusions={c['total_fusions']:5d} "
+              f"whiles={c['whiles']} scatters={c['scatters']}", flush=True)
+
+    before = out["modes"]["baseline_pre_pr"]["top_fusions"]
+    after = out["modes"]["tpu_shape"]["top_fusions"]
+    pct = 100.0 * (before - after) / max(before, 1)
+    out["fusion_reduction_pct_tpu_shape_vs_baseline"] = round(pct, 1)
+    print(f"tpu_shape vs baseline_pre_pr: {before} -> {after} top-level "
+          f"fusions ({pct:+.1f}% reduction)")
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}")
+    if args.assert_max is not None and after > args.assert_max:
+        print(f"FAIL: tpu_shape top-level fusion count {after} exceeds "
+              f"budget {args.assert_max}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,34 +27,57 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from librabft_simulator_tpu.utils.rlimit import raise_stack_limit
-
-raise_stack_limit()
-
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
-os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
-jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+def _setup_process():
+    """Stack limit + persistent compile cache.  Called from run_check, NOT
+    at module import: tests/test_xplat_parity.py imports this module during
+    pytest collection, and module-level jax.config mutations would override
+    the tier-1 suite's cache configuration for the whole session.  The
+    cache config is also applied only when nothing configured one yet —
+    under pytest, conftest.py already owns it and run_check must not
+    repoint the rest of the session."""
+    from librabft_simulator_tpu.utils.rlimit import raise_stack_limit
+
+    raise_stack_limit()
+    if jax.config.jax_compilation_cache_dir is None:
+        os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/librabft_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def main() -> int:
+def run_check(engine_name: str = "serial", batch: int = 2048,
+              chunk: int = 96, calls: int = 2, n_nodes: int = 4,
+              delay_kind: str = "uniform", drop_prob: float = 0.0,
+              commit_chain: int = 3) -> dict:
+    """Run the same fleet on the accelerator and on CPU; diff every leaf.
+
+    Returns the result dict (``n_bad == 0`` means bit-exact).  Also the
+    entry point for ``tests/test_xplat_parity.py``, which runs the open
+    n=16/64 wide-lowering shapes whenever a chip is visible."""
     from librabft_simulator_tpu.core.types import SimParams
     from librabft_simulator_tpu.sim import parallel_sim, simulator
+    from librabft_simulator_tpu.utils import xops
 
-    engine_name = sys.argv[1] if len(sys.argv) > 1 else "serial"
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
-    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 96
-    calls = int(sys.argv[4]) if len(sys.argv) > 4 else 2
+    _setup_process()
     engine = parallel_sim if engine_name == "parallel" else simulator
-    n = int(os.environ.get("XPLAT_NODES", "4"))
-    p = SimParams(n_nodes=n,
-                  delay_kind=os.environ.get("XPLAT_DELAY", "uniform"),
-                  drop_prob=float(os.environ.get("XPLAT_DROP", "0")),
-                  commit_chain=int(os.environ.get("XPLAT_CHAIN", "3")),
+    n = n_nodes
+    p = SimParams(n_nodes=n, delay_kind=delay_kind, drop_prob=drop_prob,
+                  commit_chain=commit_chain,
                   max_clock=2**30, epoch_handoff=False,
                   queue_cap=max(32, 4 * n))
+    # Resolve the 'auto' lowering forms ONCE, against the process default
+    # backend (the chip, when one is visible): BOTH legs then run the SAME
+    # program — packed planes + dense writes on a TPU host — on two
+    # backends.  That is this tool's contract (catch backend miscompiles
+    # of the graph the chip actually runs, like the round-5 scalar-scatter
+    # bug); the semantic equivalence of the TPU forms against the proven
+    # CPU forms is pinned separately by tests/test_packing.py,
+    # tests/test_xops.py, and the fuzz campaign on CPU.
+    p = xops.resolve_params(p)
 
     def runit(device):
         with jax.default_device(device):
@@ -67,8 +90,7 @@ def main() -> int:
 
     tpus = [d for d in jax.devices() if d.platform != "cpu"]
     if not tpus:
-        print(json.dumps({"error": "no accelerator device visible"}))
-        return 2
+        return {"error": "no accelerator device visible"}
     t = runit(tpus[0])
     c = runit(jax.devices("cpu")[0])
     bad = ["/".join(str(q) for q in pt)
@@ -76,13 +98,28 @@ def main() -> int:
                jax.tree_util.tree_flatten_with_path(t)[0],
                jax.tree_util.tree_flatten_with_path(c)[0])
            if not np.array_equal(np.asarray(lt), np.asarray(lc))]
-    print(json.dumps({
+    return {
         "engine": engine_name, "n_nodes": n, "instances": batch,
         "steps": chunk * calls, "n_bad": len(bad), "bad": bad[:10],
         "commits_tpu": int(np.sum(t.ctx.commit_count)),
         "commits_cpu": int(np.sum(c.ctx.commit_count)),
-    }))
-    return 0 if not bad else 1
+    }
+
+
+def main() -> int:
+    out = run_check(
+        engine_name=sys.argv[1] if len(sys.argv) > 1 else "serial",
+        batch=int(sys.argv[2]) if len(sys.argv) > 2 else 2048,
+        chunk=int(sys.argv[3]) if len(sys.argv) > 3 else 96,
+        calls=int(sys.argv[4]) if len(sys.argv) > 4 else 2,
+        n_nodes=int(os.environ.get("XPLAT_NODES", "4")),
+        delay_kind=os.environ.get("XPLAT_DELAY", "uniform"),
+        drop_prob=float(os.environ.get("XPLAT_DROP", "0")),
+        commit_chain=int(os.environ.get("XPLAT_CHAIN", "3")))
+    print(json.dumps(out))
+    if "error" in out:
+        return 2
+    return 0 if not out["n_bad"] else 1
 
 
 if __name__ == "__main__":

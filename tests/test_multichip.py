@@ -17,6 +17,9 @@ def mesh():
     return mesh_ops.make_mesh(n_dp=4, n_mp=2)
 
 
+@pytest.mark.slow  # 102,400-step sharded run on 8 *virtual* CPU devices:
+# multi-minute compile+run, the single biggest sink in the 870 s tier-1
+# budget; the placement/psum tests below keep multichip wiring covered.
 def test_sharded_equals_unsharded(mesh):
     p = SimParams(n_nodes=3, max_clock=300)
     seeds = np.arange(16, dtype=np.uint32)
@@ -26,6 +29,8 @@ def test_sharded_equals_unsharded(mesh):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # 25,600-step sharded lane-engine run on the virtual
+# mesh (see above); environment-bound, not logic-bound.
 def test_sharded_parallel_engine_equals_unsharded(mesh):
     """The lane-compacted throughput engine is also collective-free SPMD
     over dp: sharded == unsharded, bit-exact."""

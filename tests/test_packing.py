@@ -1,0 +1,121 @@
+"""core/packing.py: packed state planes must be a lossless re-layout.
+
+Two contracts: (1) pack -> unpack is the identity for every leaf of
+``SimState``/``PSimState`` (uint32 bitcast, bool as 0/1 — bit-preserving);
+(2) the packed engines produce bit-identical trajectories to the unpacked
+ones — committed chains, counters, and every other state leaf.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from librabft_simulator_tpu.core import packing
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import parallel_sim as P
+from librabft_simulator_tpu.sim import simulator as S
+
+
+def assert_trees_equal(a, b):
+    flat_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert len(flat_a) == len(flat_b)
+    for (pt, la), (_, lb) in zip(flat_a, flat_b):
+        path = "/".join(str(q) for q in pt)
+        assert la.dtype == lb.dtype, path
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb), path)
+
+
+def test_node_width_matches_slot_map():
+    p = SimParams(n_nodes=4)
+    slots, width = packing.slot_map(p.structural())
+    assert width == packing.node_width(p)
+    assert width == sum(s[1] for s in slots)
+    # Offsets tile the vector exactly.
+    off = 0
+    for o, size, _, _ in slots:
+        assert o == off
+        off += size
+
+
+def test_pack_unpack_roundtrip_sim_state():
+    # A warmed-up state exercises nonzero values in every table.
+    p = SimParams(n_nodes=3, max_clock=400)
+    st = S.run_to_completion(p, S.init_state(p, 5))
+    pst = packing.pack_state(p, st)
+    assert pst.planes.shape == (p.n_nodes, packing.node_width(p))
+    assert_trees_equal(st, packing.unpack_state(p, pst))
+
+
+def test_pack_unpack_roundtrip_batched():
+    p = SimParams(n_nodes=3, max_clock=300)
+    st = S.run_to_completion(p, S.init_batch(p, np.arange(4, dtype=np.uint32)),
+                             batched=True)
+    pst = packing.pack_state(p, st)
+    assert pst.planes.shape == (4, p.n_nodes, packing.node_width(p))
+    assert_trees_equal(st, packing.unpack_state(p, pst))
+
+
+def test_pack_unpack_roundtrip_psim_state():
+    # Initial state only (no engine compile): covers every PSimState leaf's
+    # slot/dtype mapping; nonzero-value coverage rides the slow engine
+    # identity test below and the shared pack_node path of the SimState
+    # roundtrips above.
+    p = SimParams(n_nodes=4, max_clock=300, epoch_handoff=False)
+    st = P.init_state(p, 2)
+    pst = P.pack_pstate(p, st)
+    assert pst.planes.shape == (p.n_nodes, packing.node_width(p))
+    assert_trees_equal(st, P.unpack_pstate(p, pst))
+
+
+def test_packed_serial_engine_bit_identical():
+    """Same seed, packed vs unpacked layout: every leaf equal — including
+    the committed chains (ctx.log_*) and all counters."""
+    p = SimParams(n_nodes=3, max_clock=400)
+    a = S.run_to_completion(p, S.init_state(p, 0))
+    b = S.run_to_completion(dataclasses.replace(p, packed=True),
+                            S.init_state(p, 0))
+    assert_trees_equal(a, b)
+    assert min(int(c) for c in a.ctx.commit_count) > 0  # non-trivial run
+
+
+@pytest.mark.slow  # two fresh parallel-engine compiles (~3 min on CPU);
+# tier-1 coverage of the packed layout rides the serial identity test +
+# the cheap PSimState roundtrip above.
+def test_packed_parallel_engine_bit_identical():
+    p = SimParams(n_nodes=4, max_clock=400, epoch_handoff=False)
+    a = P.run_to_completion(p, P.init_state(p, 1), chunk=32)
+    b = P.run_to_completion(dataclasses.replace(p, packed=True),
+                            P.init_state(p, 1), chunk=32)
+    assert_trees_equal(a, b)
+    assert int(a.n_events) > 0
+
+
+def test_gated_handlers_bit_identical():
+    """gate_handlers=True (the TPU default) vs False (the CPU default):
+    the lax.cond gating must not change the trajectory — the false branch
+    returns (s_a, False)/(s_a, nx_a, cx_a), which is exactly what the
+    ungated per-field _sel would have selected for the wrong kind.  CPU
+    auto-resolves the gate off, so without this test the gated graph would
+    only ever execute on-chip."""
+    p = SimParams(n_nodes=3, max_clock=400)
+    a = S.run_to_completion(dataclasses.replace(p, gate_handlers=False),
+                            S.init_state(p, 0))
+    b = S.run_to_completion(dataclasses.replace(p, gate_handlers=True),
+                            S.init_state(p, 0))
+    assert_trees_equal(a, b)
+    assert min(int(c) for c in a.ctx.commit_count) > 0
+
+
+def test_resolved_params_cpu_defaults():
+    """On a CPU backend the auto fields resolve to the proven forms."""
+    from librabft_simulator_tpu.utils import xops
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("resolution targets differ off-CPU")
+    p = xops.resolve_params(SimParams(n_nodes=3))
+    assert p.packed is False
+    assert p.dense_writes == "scatter"
+    assert p.gate_handlers is False  # CPU keeps the exact pre-PR graph

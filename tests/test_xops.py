@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from librabft_simulator_tpu.utils.xops import wset
+from librabft_simulator_tpu.utils.xops import scatter_set, wset
 
 
 def test_wset_matches_at_set_1d():
@@ -57,6 +57,130 @@ def test_wset_dtype_cast_matches_at():
     arr = jnp.zeros((4,), jnp.uint32)
     out = wset(arr, jnp.int32(3), 7)  # python int -> uint32, like .at[].set
     assert out.dtype == jnp.uint32 and int(out[3]) == 7
+
+
+def _both_modes(dst, idx, src):
+    a = scatter_set(dst, idx, src, mode="scatter")
+    b = scatter_set(dst, idx, src, mode="dense")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    return a
+
+
+def test_scatter_set_dense_matches_scatter_1d():
+    """The dense one-hot sum-select queue write (the TPU form) must equal
+    the proven .at[].set(mode='drop') scatter bit-for-bit."""
+    rng = np.random.default_rng(0)
+    dst = jnp.asarray(rng.integers(-50, 50, 32), jnp.int32)
+    idx = jnp.asarray([3, 7, 0, 31, 12], jnp.int32)
+    src = jnp.asarray(rng.integers(-50, 50, 5), jnp.int32)
+    out = _both_modes(dst, idx, src)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(dst.at[idx].set(src)))
+
+
+def test_scatter_set_sentinel_and_negative_drop():
+    """Index semantics follow .at[] exactly in BOTH forms: the sentinel
+    idx == len (the queue's overflow path) and far-out-of-range targets
+    write nothing; values in [-len, 0) wrap (numpy semantics — unlike
+    wset, which drops all negatives)."""
+    dst = jnp.arange(8, dtype=jnp.int32)
+    idx = jnp.asarray([8, -1, 100, -9], jnp.int32)
+    src = jnp.asarray([91, 92, 93, 94], jnp.int32)
+    out = _both_modes(dst, idx, src)
+    want = np.arange(8)
+    want[7] = 92  # -1 wraps; 8, 100, -9 all drop
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_scatter_set_duplicate_indices_last_wins():
+    """The DENSE form's duplicate resolution is part of scatter_set's own
+    contract: the last matching source wins (deterministic by
+    construction).  The scatter form is NOT asserted here — XLA leaves
+    repeated-index .at[].set ordering unspecified, and the engine never
+    produces duplicates anyway (queue targets are distinct free slots or
+    the dropped sentinel), so pinning XLA's current order would just make
+    a JAX upgrade fail this test spuriously."""
+    dst = jnp.zeros((6,), jnp.int32)
+    idx = jnp.asarray([2, 4, 2, 2], jnp.int32)
+    src = jnp.asarray([10, 20, 30, 40], jnp.int32)
+    out = scatter_set(dst, idx, src, mode="dense")
+    assert int(out[2]) == 40 and int(out[4]) == 20
+
+
+def test_scatter_set_payload_rows():
+    """2-D row payloads: the dense form is the one-hot integer matmul
+    (PERF_NOTES' 'MXU-shaped payload select')."""
+    rng = np.random.default_rng(1)
+    dst = jnp.asarray(rng.integers(-2**30, 2**30, (16, 20)), jnp.int32)
+    idx = jnp.asarray([0, 15, 16, 3, 7], jnp.int32)  # incl sentinel drop
+    src = jnp.asarray(rng.integers(-2**30, 2**30, (5, 20)), jnp.int32)
+    out = _both_modes(dst, idx, src)
+    np.testing.assert_array_equal(np.asarray(out[3]), np.asarray(src[3]))
+    # Dense-only: a duplicate row target resolves last-wins (scatter_set's
+    # own contract; XLA's .at[] ordering for duplicates is unspecified).
+    dup = scatter_set(dst, jnp.asarray([5, 5], jnp.int32), src[:2],
+                      mode="dense")
+    np.testing.assert_array_equal(np.asarray(dup[5]), np.asarray(src[1]))
+
+
+def test_scatter_set_3d_rows():
+    """>1 trailing dim (not a current engine shape): both forms must still
+    agree, so the dense form never works-on-CPU-only."""
+    rng = np.random.default_rng(4)
+    dst = jnp.asarray(rng.integers(0, 100, (6, 3, 2)), jnp.int32)
+    idx = jnp.asarray([1, 6, 4], jnp.int32)  # incl sentinel drop
+    src = jnp.asarray(rng.integers(0, 100, (3, 3, 2)), jnp.int32)
+    _both_modes(dst, idx, src)
+
+
+def test_bool_env_strict(monkeypatch):
+    """LIBRABFT_PACKED=off must not silently mean 'on'."""
+    from librabft_simulator_tpu.utils import xops
+
+    monkeypatch.setenv(xops.PACKED_ENV, "off")
+    assert xops.packed_mode() is False
+    monkeypatch.setenv(xops.PACKED_ENV, "on")
+    assert xops.packed_mode() is True
+    monkeypatch.setenv(xops.PACKED_ENV, "bogus")
+    with np.testing.assert_raises(ValueError):
+        xops.packed_mode()
+
+
+def test_scatter_set_bool_and_scalar_src():
+    dst = jnp.zeros((10,), jnp.bool_)
+    idx = jnp.asarray([1, 9, 10, 4], jnp.int32)
+    out = _both_modes(dst, idx, True)
+    want = np.zeros(10, bool)
+    want[[1, 9, 4]] = True
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_scatter_set_dense_under_vmap():
+    """The batched lowering the serial engine actually uses."""
+    B, cm, m = 64, 12, 5
+    rng = np.random.default_rng(2)
+    dst = jnp.asarray(rng.integers(0, 100, (B, cm)), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, cm + 1, (B, m)), jnp.int32)  # incl drop
+    src = jnp.asarray(rng.integers(0, 100, (B, m)), jnp.int32)
+    f = lambda mode: jax.jit(jax.vmap(  # noqa: E731
+        lambda d, i, s: scatter_set(d, i, s, mode=mode)))(dst, idx, src)
+    np.testing.assert_array_equal(np.asarray(f("scatter")),
+                                  np.asarray(f("dense")))
+
+
+def test_dense_node_update_plane_matches_per_leaf():
+    """The packed engine's plane write (one wset on [n, S]) must equal the
+    per-leaf scatter form, including sentinel-index drop."""
+    rng = np.random.default_rng(3)
+    planes = jnp.asarray(rng.integers(-2**30, 2**30, (4, 33)), jnp.int32)
+    row = jnp.asarray(rng.integers(-2**30, 2**30, 33), jnp.int32)
+    for a in [0, 3]:
+        np.testing.assert_array_equal(
+            np.asarray(wset(planes, jnp.int32(a), row)),
+            np.asarray(planes.at[a].set(row)))
+    # Sentinel index == n drops the write entirely.
+    np.testing.assert_array_equal(
+        np.asarray(wset(planes, jnp.int32(4), row)), np.asarray(planes))
 
 
 def test_wset_under_vmap():

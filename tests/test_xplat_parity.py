@@ -1,0 +1,61 @@
+"""On-chip cross-platform parity as a pytest leg (scripts/xplat_parity.py).
+
+Round 5 validated TPU == CPU bit-parity at n=4 shapes, but the tunnel died
+before the n=16/64 parallel lowerings (lane routing, flat inbox scatters at
+wide widths) could be diffed — PERF_NOTES.md carries that caveat on the
+config-3/5 TPU sweep rows.  These tests close it AUTOMATICALLY the next
+time the suite runs with a chip visible (e.g. JAX_PLATFORMS=axon): they
+skip themselves on CPU-only hosts, so the tier-1 CPU gate is unaffected.
+
+Every test asserts n_bad == 0: every state leaf of the accelerator run
+equals the CPU run bit-for-bit.
+"""
+
+import os
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from xplat_parity import run_check  # noqa: E402
+
+
+def _accelerator_visible() -> bool:
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(not _accelerator_visible(),
+                       reason="no accelerator device visible "
+                              "(jax.devices() is CPU-only)"),
+]
+
+
+def test_serial_fleet_bit_parity():
+    """The round-5 validated shape, re-checked after any engine change
+    (this PR: packed planes + dense queue writes under TPU lowering)."""
+    res = run_check("serial", batch=2048, chunk=96, calls=2)
+    assert res.get("n_bad") == 0, res
+
+
+def test_parallel_n16_2chain_bit_parity():
+    """Open caveat (PERF_NOTES.md): sweep config-5's n=16 parallel
+    lowering was never diffed on device."""
+    res = run_check("parallel", batch=256, chunk=8, calls=2, n_nodes=16,
+                    commit_chain=2)
+    assert res.get("n_bad") == 0, res
+
+
+def test_parallel_n64_pareto_drop_bit_parity():
+    """Open caveat (PERF_NOTES.md): sweep config-3's n=64 lane routing +
+    flat inbox scatters at wide widths."""
+    res = run_check("parallel", batch=64, chunk=8, calls=2, n_nodes=64,
+                    delay_kind="pareto", drop_prob=0.05)
+    assert res.get("n_bad") == 0, res
