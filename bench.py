@@ -251,6 +251,13 @@ def _time_engine(engine, p, batch, chunk, reps, init_kw=None):
         res["window_occupancy"] = round(
             (e1 - e0) / max(chunk * reps * batch, 1), 2)
         res["occupancy_ceiling"] = lanes_of(p) * drain_of(p)
+    if p.telemetry:
+        # In-graph telemetry plane (telemetry/plane.py), decoded once after
+        # the timed window: event-kind counts, loss tallies, queue pressure,
+        # and p50/p99 latency bucket bounds, merged over the fleet.
+        from librabft_simulator_tpu.telemetry import report as tel_report
+
+        res["telemetry"] = tel_report.telemetry_block(p, st)
     return res
 
 
@@ -274,6 +281,12 @@ def run_bench(n_nodes: int, batch: int, chunk: int, reps: int,
     # compiled kernel cannot run on the CPU backend, so any CPU fallback
     # (dead tunnel, attach timeout, in-run failure rerun) downgrades to the
     # XLA select rather than poisoning the fallback contract line.
+    # BENCH_TELEMETRY=1 runs the bench with the in-graph telemetry plane on
+    # and attaches its decoded block to the contract line.  Off by default:
+    # the headline number stays the cost of the bare step graph.
+    from librabft_simulator_tpu.utils.xops import _bool_env
+
+    params_kw.setdefault("telemetry", _bool_env("BENCH_TELEMETRY") or False)
     select = os.environ.get("BENCH_SELECT", "xla")
     if select == "pallas" and jax.devices()[0].platform == "cpu":
         select = "xla"
@@ -359,6 +372,8 @@ def run_all() -> dict:
         "platform": platform,
         "probe": _PROBE_DIAG,
     }
+    if "telemetry" in head:
+        out["telemetry"] = head["telemetry"]
     for name, r in results.items():
         if r is not head:
             out[f"{name}_rounds_per_sec"] = round(r["rounds_per_sec"], 1)

@@ -23,6 +23,7 @@ import jax
 import numpy as np
 
 from ..core.types import SimParams
+from ..telemetry import plane
 
 
 def round_switch_table(p: SimParams, st, instance: Optional[int] = None):
@@ -35,17 +36,47 @@ def round_switch_table(p: SimParams, st, instance: Optional[int] = None):
     count = int(g(st.trace_count) if instance is None else g(st.trace_count)[instance])
     if instance is not None:
         node, rnd, time = node[instance], rnd[instance], time[instance]
-    T = p.trace_cap
-    if count > T:
-        # Ring overflowed: only the last T switches are available.
-        count = T
+    # Chronological decode (telemetry/plane.py ring_order): after overflow
+    # only the last T switches survive, rotated in storage — iterating in
+    # storage order would let a STALE entry (physically earlier, logically
+    # newer) shadow the true first entry time of a (round, node) cell under
+    # the first-write-wins rule below.
+    order = plane.ring_order(count, p.trace_cap)
     max_round = int(rnd.max(initial=0))
     out = np.full((max_round + 1, p.n_nodes), -1, np.int64)
-    for i in range(count):
+    for i in order:
         r, a, t = int(rnd[i]), int(node[i]), int(time[i])
         if out[r, a] < 0:
             out[r, a] = t
     return out
+
+
+def summary_dict(p: SimParams, st, instance: Optional[int] = None,
+                 table: Optional[np.ndarray] = None) -> dict:
+    """The DataWriter summary as a plain dict (no files): shared between
+    :class:`DataWriter` and the telemetry run-report exporter
+    (telemetry/report.py)."""
+    if table is None:
+        table = round_switch_table(p, st, instance)
+    sel = (lambda x: x) if instance is None else (lambda x: x[instance])
+    g = lambda x: np.asarray(jax.device_get(x))  # noqa: E731
+    return {
+        "n_nodes": p.n_nodes,
+        "clock": int(sel(g(st.clock))),
+        "n_events": int(sel(g(st.n_events))),
+        "n_msgs_sent": int(sel(g(st.n_msgs_sent))),
+        "n_msgs_dropped": int(sel(g(st.n_msgs_dropped))),
+        # Serial engine counts shared-queue overflow; the parallel
+        # engine counts per-receiver inbox overflow.
+        "n_queue_full": int(sel(g(
+            st.n_queue_full if hasattr(st, "n_queue_full")
+            else st.n_inbox_full))),
+        "commit_count": g(st.ctx.commit_count)[instance].tolist()
+        if instance is not None else g(st.ctx.commit_count).tolist(),
+        "sync_jumps": g(st.ctx.sync_jumps)[instance].tolist()
+        if instance is not None else g(st.ctx.sync_jumps).tolist(),
+        "max_round": int(table.shape[0]) - 1,
+    }
 
 
 class DataWriter:
@@ -59,8 +90,6 @@ class DataWriter:
     def write(self, st, instance: Optional[int] = None) -> dict:
         p = self.p
         table = round_switch_table(p, st, instance)
-        sel = (lambda x: x) if instance is None else (lambda x: x[instance])
-        g = lambda x: np.asarray(jax.device_get(x))  # noqa: E731
 
         with open(os.path.join(self.path, "round_switches.txt"), "w", newline="") as f:
             w = csv.writer(f)
@@ -68,27 +97,10 @@ class DataWriter:
             for row in table:
                 w.writerow(["" if t < 0 else int(t) for t in row])
 
-        n_msgs = int(sel(g(st.n_msgs_sent)))
+        summary = summary_dict(p, st, instance, table=table)
         with open(os.path.join(self.path, "number_of_messages.txt"), "w") as f:
-            f.write(f"{n_msgs}\n")
+            f.write(f"{summary['n_msgs_sent']}\n")
 
-        summary = {
-            "n_nodes": p.n_nodes,
-            "clock": int(sel(g(st.clock))),
-            "n_events": int(sel(g(st.n_events))),
-            "n_msgs_sent": n_msgs,
-            "n_msgs_dropped": int(sel(g(st.n_msgs_dropped))),
-            # Serial engine counts shared-queue overflow; the parallel
-            # engine counts per-receiver inbox overflow.
-            "n_queue_full": int(sel(g(
-                st.n_queue_full if hasattr(st, "n_queue_full")
-                else st.n_inbox_full))),
-            "commit_count": g(st.ctx.commit_count)[instance].tolist()
-            if instance is not None else g(st.ctx.commit_count).tolist(),
-            "sync_jumps": g(st.ctx.sync_jumps)[instance].tolist()
-            if instance is not None else g(st.ctx.sync_jumps).tolist(),
-            "max_round": int(table.shape[0]) - 1,
-        }
         with open(os.path.join(self.path, "summary.json"), "w") as f:
             json.dump(summary, f, indent=2)
         return summary

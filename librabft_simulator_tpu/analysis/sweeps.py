@@ -36,7 +36,7 @@ def _fleet_stats(p: SimParams, st, elapsed: float) -> dict:
         cc = cc[None]
         cur = cur[None]
     rounds = (cur.max(axis=-1) - 1).sum()
-    return {
+    out = {
         "instances": int(cc.shape[0]),
         "n_nodes": p.n_nodes,
         "total_commits": int(cc.sum()),
@@ -53,6 +53,13 @@ def _fleet_stats(p: SimParams, st, elapsed: float) -> dict:
                             else st.n_inbox_full).sum()),
         "sync_jumps": int(g(st.ctx.sync_jumps).sum()),
     }
+    if p.telemetry:
+        # Merged in-graph telemetry (event-kind counts, queue pressure,
+        # latency quantile bounds) rides along on every sweep row.
+        from ..telemetry import report as tel_report
+
+        out["telemetry"] = tel_report.telemetry_block(p, st)
+    return out
 
 
 def run_config(p: SimParams, n_instances: int, seed0: int = 0,
@@ -108,9 +115,12 @@ def baseline_configs(scale: float = 1.0) -> dict:
     }
 
 
-def run_all(scale: float = 1.0, out_path: str | None = None) -> dict:
+def run_all(scale: float = 1.0, out_path: str | None = None,
+            telemetry: bool = False) -> dict:
     results = {}
     for name, (p, n, f_mode) in baseline_configs(scale).items():
+        if telemetry:
+            p = dataclasses.replace(p, telemetry=True)
         if f_mode == "sweep":
             results[name] = [
                 dataclasses.asdict(r)
@@ -131,6 +141,9 @@ def main(argv=None):
     ap.add_argument("--scale", type=float, default=0.01,
                     help="instance-count scale factor (1.0 = full BASELINE sizes)")
     ap.add_argument("--out", default=None, help="write JSON to this path")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="run with SimParams.telemetry on and attach the "
+                         "merged telemetry block to every sweep row")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="pin the jax backend (the environment's TPU plugin "
                          "ignores JAX_PLATFORMS and hangs ~25 min when its "
@@ -144,7 +157,7 @@ def main(argv=None):
         print("[sweep] tpu tunnel relay not listening; pinning cpu",
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
-    results = run_all(args.scale, args.out)
+    results = run_all(args.scale, args.out, telemetry=args.telemetry)
     print(json.dumps(results, indent=2))
 
 

@@ -17,6 +17,7 @@ from flax import struct
 
 from . import pacemaker as pm_ops
 from . import store as store_ops
+from ..telemetry import profiling
 from ..utils.xops import wset
 from .types import (
     NEVER, Context, NodeExtra, Pacemaker, SimParams, Store, pack_payload,
@@ -117,8 +118,9 @@ def update_node(
     next_sched = jnp.where(qc_created, _i32(clock), pa.next_sched)
 
     # --- Deliver commits / switch epochs (node.rs:284-285, 308-352).
-    s, nx, ctx, ho_switched, ho_epoch, ho_pack = process_commits(
-        p, s, nx, ctx, weights, author)
+    with profiling.scope("commit_delivery"):
+        s, nx, ctx, ho_switched, ho_epoch, ho_pack = process_commits(
+            p, s, nx, ctx, weights, author)
 
     # --- Commit tracker (node.rs:286-297, 363-397).
     nx, tr_query_all, tr_next = update_tracker(p, nx, s, clock)

@@ -159,6 +159,8 @@ class PackedSimState:
     trace_round: Array
     trace_time: Array
     trace_count: Array
+    metrics: Array
+    flight: Array
 
 
 _SIM_COMMON = _common_fields(SimState)

@@ -37,6 +37,7 @@ from .types import (
     Store,
     VoteMsg,
 )
+from ..telemetry import profiling
 from ..utils import hashing as H
 from ..utils.xops import wset
 
@@ -573,6 +574,11 @@ def has_timeout(s: Store, author, round_):
 def check_new_qc(p: SimParams, s: Store, weights, author):
     """record_store.rs:702-738: if our proposal won the election, mint the QC
     from the recorded votes.  Returns (store, created)."""
+    with profiling.scope("qc_mint"):
+        return _check_new_qc(p, s, weights, author)
+
+
+def _check_new_qc(p: SimParams, s: Store, weights, author):
     won = s.election == ELECTION_WON
     bvar = s.won_var
     sl = _slot(p, s.current_round)

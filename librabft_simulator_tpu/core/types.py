@@ -153,6 +153,16 @@ class SimParams:
     max_clock: int = 1000
     dur_table_size: int = 64
     trace_cap: int = 0        # round-switch trace entries (0 = tracing off)
+    # In-graph telemetry (telemetry/plane.py): a fixed-shape [M] int32
+    # metrics plane (per-event-kind counters, queue high-water marks,
+    # drop/overflow/sync-jump tallies, latency histograms, lane-engine
+    # window health) plus a last-K-events flight-recorder ring, both
+    # per instance, zero host sync in the hot loop.  Static and default
+    # OFF: disabled, the arrays are zero-width and every update compiles
+    # out, so the graph is bit- and kernel-identical to a telemetry-free
+    # build (tests/test_telemetry.py + the kernel-census CI gate).
+    telemetry: bool = False
+    flight_cap: int = 32      # K: flight-recorder ring rows (telemetry on)
 
     def __post_init__(self):
         if self.epoch_handoff and self.handoff_epochs < 1:
@@ -160,6 +170,11 @@ class SimParams:
                 "handoff_epochs must be >= 1 when epoch_handoff is on "
                 f"(got {self.handoff_epochs}); the three engines would "
                 "otherwise diverge on a zero-width ring")
+        if self.telemetry and self.flight_cap < 1:
+            raise ValueError(
+                f"flight_cap must be >= 1 when telemetry is on "
+                f"(got {self.flight_cap}); the flight-recorder ring "
+                "write indices are taken modulo flight_cap")
 
     @property
     def lam_fp(self) -> int:
@@ -659,3 +674,10 @@ class SimState:
     trace_round: Array  # [T]
     trace_time: Array   # [T]
     trace_count: Array
+    # Telemetry (telemetry/plane.py; both zero-width when
+    # SimParams.telemetry is off): the [M] metrics plane and the
+    # [K, FR_COLS] flight-recorder ring (kind, actor, time, round, queue
+    # depth per processed event; running count in the plane's fr_count
+    # slot).
+    metrics: Array      # [M] int32
+    flight: Array       # [K, FR_COLS] int32

@@ -450,6 +450,20 @@ class OracleSim:
         self.trace_round = [0] * T
         self.trace_time = [0] * T
         self.trace_count = 0
+        # Telemetry mirror (telemetry/plane.py): the observables the device
+        # metrics plane derives per event, kept as raw host values so
+        # tests/test_telemetry.py can pin device counters/histograms against
+        # exact tallies and raw latency samples.  (drops/overflow/sync-jump
+        # slots mirror existing counters and need no extra bookkeeping.)
+        self.tel = dict(
+            ev_kind=[0, 0, 0, 0],         # processed events by KIND_*
+            queue_hwm=0,                  # post-step total queue occupancy
+            node_depth_hwm=[0] * n,       # post-step per-receiver depth
+            round_lats=[],                # dwell time at each round switch
+            commit_lats=[],               # proposal->commit, global time
+            commit_lat_miss=0,            # committed block left the window
+            flight=[],                    # (kind, actor, time, round, depth)
+        )
 
     def _select_event(self):
         p = self.p
@@ -529,6 +543,9 @@ class OracleSim:
         is_response = kind == KIND_RESPONSE and not is_timer
         do_update = is_timer or is_notify or is_response
 
+        self.tel["ev_kind"][KIND_TIMER if is_timer else kind] += 1
+        cc_pre = cx.commit_count  # pre-handler, matching the device's cx_a
+
         should_sync = False
         if is_notify:
             should_sync = handle_notification(p, s, self.weights, pay_in)
@@ -536,6 +553,7 @@ class OracleSim:
             handle_response(p, s, nx, cx, self.weights, pay_in)
 
         pm_round_before = pm.active_round
+        pm_start_before = pm.round_start
         if do_update:
             actions = update_node(p, s, pm, nx, cx, self.weights, a, local_clock,
                                   self.dur_table)
@@ -548,6 +566,26 @@ class OracleSim:
                 self.trace_round[pos] = pm.active_round
                 self.trace_time[pos] = clock
             self.trace_count += 1
+            # Round-switch latency: local-clock dwell in the round just left
+            # (mirrors the device's pm_f.round_start - pm_a.round_start).
+            self.tel["round_lats"].append(max(pm.round_start - pm_start_before, 0))
+        if do_update and cx.commit_count > cc_pre:
+            # Proposal -> commit latency of the newest committed entry,
+            # recovered from the block table while the block is in-window;
+            # lowest valid variant on ties (mirrors telemetry/plane.py
+            # commit_latency exactly).
+            pos = (cx.commit_count - 1) % p.commit_log
+            r_c = cx.log_round[pos]
+            sl = r_c % p.window
+            v_c = next((v for v in range(p.variants)
+                        if s.blk_valid[sl][v] and s.blk_round[sl][v] == r_c),
+                       None)
+            if v_c is None:
+                self.tel["commit_lat_miss"] += 1
+            else:
+                author_b = min(max(s.blk_author[sl][v_c], 0), n - 1)
+                self.tel["commit_lats"].append(max(
+                    clock - (s.blk_time[sl][v_c] + self.startup[author_b]), 0))
 
         silent = self.byz_silent[a]
         want_sync_req = is_notify and should_sync and not silent
@@ -645,6 +683,21 @@ class OracleSim:
                 min(actions.next_sched + self.startup[a], NEVER)
             self.timer_time[a] = max(next_g, clock + 1)
             self.timer_stamp[a] = timer_stamp_new
+
+        # Telemetry: post-step queue pressure + flight-recorder entry
+        # (mirrors the device's post-write depth_n/qtot and flight row).
+        depths = [0] * n
+        for mm in self.queue:
+            if mm.valid:
+                depths[min(max(mm.receiver, 0), n - 1)] += 1
+        qtot = sum(depths)
+        tel = self.tel
+        tel["queue_hwm"] = max(tel["queue_hwm"], qtot)
+        tel["node_depth_hwm"] = [
+            max(h, d) for h, d in zip(tel["node_depth_hwm"], depths)]
+        tel["flight"].append(dict(
+            kind=KIND_TIMER if is_timer else kind, actor=a, time=clock,
+            round=s.current_round, depth=qtot))
 
         self.clock = clock
         self.stamp_ctr += total_consumed

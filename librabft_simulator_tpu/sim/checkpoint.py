@@ -92,6 +92,11 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
                          "trace_count"):
                 leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
+            if field in ("metrics", "flight"):
+                # Round 7's telemetry plane + flight recorder: diagnostic
+                # soft state, restored empty from older checkpoints.
+                leaves.append(np.zeros(leaf.shape, leaf.dtype))
+                continue
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]
         if arr.shape != leaf.shape:
@@ -104,6 +109,11 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
             if field in ("trace_node", "trace_round", "trace_time"):
                 # trace_cap changed between save and resume: the ring is
                 # diagnostic soft state — restart it empty.
+                leaves.append(np.zeros(leaf.shape, leaf.dtype))
+                continue
+            if field in ("metrics", "flight"):
+                # telemetry/flight_cap changed between save and resume:
+                # observability soft state — restart it empty.
                 leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
             raise ValueError(f"{key}: shape {arr.shape} != {leaf.shape}")

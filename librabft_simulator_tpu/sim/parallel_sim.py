@@ -85,6 +85,8 @@ from ..core.types import (
     sat_add,
     unpack_payload,
 )
+from ..telemetry import plane as tplane
+from ..telemetry.profiling import scope
 from ..utils import hashing as H
 from ..utils import xops
 from ..utils.xops import wset
@@ -145,6 +147,10 @@ class PSimState:
     trace_round: jnp.ndarray
     trace_time: jnp.ndarray
     trace_count: jnp.ndarray
+    # Telemetry plane + flight-recorder ring (telemetry/plane.py); both
+    # zero-width when SimParams.telemetry is off.
+    metrics: jnp.ndarray
+    flight: jnp.ndarray
 
 
 @struct.dataclass
@@ -182,6 +188,8 @@ class PackedPSimState:
     trace_round: jnp.ndarray
     trace_time: jnp.ndarray
     trace_count: jnp.ndarray
+    metrics: jnp.ndarray
+    flight: jnp.ndarray
 
 
 _PSIM_COMMON = packing._common_fields(PSimState)
@@ -294,6 +302,8 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         trace_round=jnp.zeros((p.trace_cap,), I32),
         trace_time=jnp.zeros((p.trace_cap,), I32),
         trace_count=_i32(0),
+        metrics=tplane.init_plane(p),
+        flight=tplane.init_flight(p),
     )
 
 
@@ -365,9 +375,17 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     g_ipay = st.in_pay[sel]
 
     def drain_iter(c, _):
-        (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
-         ev_n, drop_n, tr_n, tr_r, tr_t, tr_c) = c
+        if p.telemetry:
+            (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
+             ev_n, drop_n, tr_n, tr_r, tr_t, tr_c, m, fl) = c
+        else:
+            (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
+             ev_n, drop_n, tr_n, tr_r, tr_t, tr_c) = c
+            m = fl = None
         pm_pre_round = g_pm.active_round  # [A] for the round-switch trace
+        pm_pre_start = g_pm.round_start   # [A] for the round-latency histogram
+        pre_cc = g_cx.commit_count        # [A] for the commit-latency histogram
+        pre_sync = g_cx.sync_jumps        # [A] for the sync-jump tally
         t_l, k_l, slot_l, is_tm = _earliest(g_iv, g_it, g_ik, g_is, g_timer)
         act = lane_on & (t_l < hz) & (t_l <= st.max_clock)
         slot_c = jnp.maximum(slot_l, 0)
@@ -507,11 +525,54 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
             tr_t = tr_t.at[tpos].set(t_l, mode="drop")
         tr_c = tr_c + jnp.sum(switched_tr)
 
+        # ---- Telemetry accumulation for this drain iteration (lane-wise
+        # masks; compiled out when SimParams.telemetry is off).
+        if p.telemetry:
+            with scope("telemetry"):
+                m = tplane.bump(p, m, "ev_notify", jnp.sum(is_notify))
+                m = tplane.bump(p, m, "ev_request", jnp.sum(is_request))
+                m = tplane.bump(p, m, "ev_response", jnp.sum(is_response))
+                m = tplane.bump(p, m, "ev_timer", jnp.sum(act & is_tm))
+                m = tplane.bump(p, m, "drops", jnp.sum(dropped))
+                m = tplane.bump(p, m, "sync_jumps",
+                                jnp.sum(g_cx.sync_jumps - pre_sync))
+                rlat = jnp.maximum(g_pm.round_start - pm_pre_start, 0)
+                m = tplane.bump_hist(p, m, "round_lat_hist", rlat,
+                                     switched_tr)
+                committed = g_cx.commit_count > pre_cc
+                cfound, clat = jax.vmap(
+                    lambda s_r, cx_r, t: tplane.commit_latency(
+                        p, s_r, cx_r, st.startup, t))(g_store, g_cx, t_l)
+                m = tplane.bump_hist(p, m, "commit_lat_hist", clat,
+                                     committed & cfound)
+                m = tplane.bump(p, m, "commit_lat_miss",
+                                jnp.sum(committed & ~cfound))
+                # Flight recorder: one row per active lane, appended in lane
+                # order (same ring discipline as the trace ring above).  When
+                # more lanes are active than the ring holds, ranks K apart
+                # would collide on one slot and duplicate-index scatter order
+                # is unspecified — keep only the newest flight_cap ranks so
+                # every written slot has exactly one writer (the older rows
+                # would have been overwritten anyway).
+                frc = tplane.read(p, m, "fr_count")
+                fr_rank = jnp.cumsum(act) - 1
+                fr_keep = act & (fr_rank >= jnp.sum(act) - p.flight_cap)
+                fpos = jnp.where(fr_keep,
+                                 jnp.remainder(frc + fr_rank, p.flight_cap),
+                                 _i32(p.flight_cap))
+                occ = jnp.sum(g_iv, axis=1).astype(I32)
+                rows = jnp.stack(
+                    [k_l, sel, t_l, g_pm.active_round, occ], axis=1)
+                fl = fl.at[fpos].set(rows, mode="drop")
+                m = tplane.bump(p, m, "fr_count", jnp.sum(act))
+
         if _debug_tap is not None:
             jax.debug.callback(_debug_tap, act, t_l, k_l, sel, is_tm, g_ctr,
                                t_ev, hz, qualify, ordered=True)
         c2 = (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
               ev_n, drop_n, tr_n, tr_r, tr_t, tr_c)
+        if p.telemetry:
+            c2 = c2 + (m, fl)
         return c2, (go, kinds, recvs, stamps, arrive, pay_sel, banks)
 
     if p.packed:
@@ -529,9 +590,18 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         st.in_valid[sel], st.timer_time[sel], st.node_ctr[sel],
         st.ho_pay[sel], st.ho_epoch[sel], _i32(0), _i32(0),
         st.trace_node, st.trace_round, st.trace_time, st.trace_count)
-    carryN, ys = jax.lax.scan(drain_iter, carry0, None, length=K)
-    (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe, ev_n,
-     drop_n, trace_node, trace_round, trace_time, trace_count) = carryN
+    if p.telemetry:
+        carry0 = carry0 + (st.metrics, st.flight)
+    with scope("lane_drain"):
+        carryN, ys = jax.lax.scan(drain_iter, carry0, None, length=K)
+    if p.telemetry:
+        (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe, ev_n,
+         drop_n, trace_node, trace_round, trace_time, trace_count,
+         metrics, flight) = carryN
+    else:
+        (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe, ev_n,
+         drop_n, trace_node, trace_round, trace_time, trace_count) = carryN
+        metrics, flight = st.metrics, st.flight
     go_k, kind_k, recv_k, stamp_k, arrive_k, paysel_k, bank_k = ys  # [K, A, .]
 
     # ---- Scatter lane state back (sel indices are distinct; inactive lanes
@@ -594,22 +664,47 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     flat_pay = bank_f[
         jnp.repeat(jnp.arange(KA), nc), paysel_k.reshape(-1)]  # [KA*nc, F]
 
-    in_valid2 = in_valid.reshape(-1).at[g].set(True, mode="drop").reshape(n, ic)
-    in_time2 = st.in_time.reshape(-1).at[g].set(
-        arrive_k.reshape(-1), mode="drop").reshape(n, ic)
-    in_kind2 = st.in_kind.reshape(-1).at[g].set(
-        kind_k.reshape(-1), mode="drop").reshape(n, ic)
-    in_stamp2 = st.in_stamp.reshape(-1).at[g].set(
-        stamp_k.reshape(-1), mode="drop").reshape(n, ic)
-    in_sender2 = st.in_sender.reshape(-1).at[g].set(
-        flat_sender, mode="drop").reshape(n, ic)
-    in_pay2 = st.in_pay.reshape(n * ic, F).at[g].set(
-        flat_pay, mode="drop").reshape(n, ic, F)
+    with scope("inbox_route"):
+        in_valid2 = in_valid.reshape(-1).at[g].set(
+            True, mode="drop").reshape(n, ic)
+        in_time2 = st.in_time.reshape(-1).at[g].set(
+            arrive_k.reshape(-1), mode="drop").reshape(n, ic)
+        in_kind2 = st.in_kind.reshape(-1).at[g].set(
+            kind_k.reshape(-1), mode="drop").reshape(n, ic)
+        in_stamp2 = st.in_stamp.reshape(-1).at[g].set(
+            stamp_k.reshape(-1), mode="drop").reshape(n, ic)
+        in_sender2 = st.in_sender.reshape(-1).at[g].set(
+            flat_sender, mode="drop").reshape(n, ic)
+        in_pay2 = st.in_pay.reshape(n * ic, F).at[g].set(
+            flat_pay, mode="drop").reshape(n, ic, F)
 
     delivered = jnp.sum(place_m)
 
+    # ---- Window-level telemetry: occupancy/stall health of the
+    # conservative window plus post-routing queue pressure.
+    if p.telemetry:
+        with scope("telemetry"):
+            m = metrics
+            m = tplane.bump(p, m, "windows", when=live)
+            # Nodes with an eligible event stalled beyond the lookahead
+            # horizon: work exists but conservatism defers it.
+            m = tplane.bump(
+                p, m, "horizon_stall",
+                jnp.sum((t_ev <= st.max_clock) & (t_ev >= hz)), when=live)
+            # Qualifying nodes that didn't fit on the A lanes.
+            m = tplane.bump(p, m, "lane_spill",
+                            jnp.maximum(jnp.sum(qualify) - A, 0), when=live)
+            m = tplane.bump(p, m, "overflow", jnp.sum(overflow_m), when=live)
+            depths = jnp.sum(in_valid2, axis=1)
+            m = tplane.region_max(p, m, "node_depth_hwm", depths)
+            m = tplane.region_max(p, m, "queue_hwm", jnp.sum(depths))
+            tel_updates = dict(metrics=m, flight=flight)
+    else:
+        tel_updates = {}
+
     return st.replace(
         **node_updates,
+        **tel_updates,
         ho_pay=ho_pay, ho_epoch=ho_epoch,
         in_valid=in_valid2, in_time=in_time2, in_kind=in_kind2,
         in_stamp=in_stamp2, in_sender=in_sender2, in_pay=in_pay2,

@@ -47,6 +47,8 @@ from ..core.types import (
     sat_add,
     unpack_payload,
 )
+from ..telemetry import plane as tplane
+from ..telemetry.profiling import scope
 from ..utils import hashing as H
 from ..utils import xops
 from ..utils.xops import scatter_set, wset
@@ -120,6 +122,8 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         trace_round=jnp.zeros((p.trace_cap,), I32),
         trace_time=jnp.zeros((p.trace_cap,), I32),
         trace_count=_i32(0),
+        metrics=tplane.init_plane(p),
+        flight=tplane.init_flight(p),
     )
 
 
@@ -199,7 +203,8 @@ def _forged_qc_payload(p: SimParams, s_a, author, pay: Payload) -> Payload:
 def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     """Process one event of one instance (loop_until body, simulator.rs:380-468)."""
     n, cm, k_chain = p.n_nodes, p.queue_cap, p.chain_k
-    idx, t_min, is_timer = _select_event(p, st)
+    with scope("event_select"):
+        idx, t_min, is_timer = _select_event(p, st)
     halt = st.halted | (t_min > st.max_clock)
     live = ~halt
     clock = jnp.maximum(st.clock, jnp.minimum(t_min, NEVER - 1))
@@ -230,34 +235,38 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     is_response = live & ~is_timer & (kind == KIND_RESPONSE)
     do_update = live & (is_timer | is_notify | is_response)
 
-    if p.gate_handlers:
-        # lax.cond short-circuits the payload handlers behind the kind
-        # predicates: unbatched lowerings skip the wrong-kind subgraph
-        # entirely (the 16.6 ms handle_response graph runs for the ~5% of
-        # events that are responses); vmapped lowerings de-branch to the
-        # same per-leaf select the explicit _sel form used, so the
-        # trajectory is bit-identical either way.
-        s_n, should_sync = jax.lax.cond(
-            is_notify,
-            lambda: data_sync.handle_notification(p, s_a, st.weights, pay_in),
-            lambda: (s_a, jnp.bool_(False)))
-        s_r, nx_r, cx_r = jax.lax.cond(
-            is_response,
-            lambda: data_sync.handle_response(
-                p, s_a, nx_a, cx_a, st.weights, pay_in),
-            lambda: (s_a, nx_a, cx_a))
-    else:
-        s_n, should_sync = data_sync.handle_notification(
-            p, s_a, st.weights, pay_in)
-        s_r, nx_r, cx_r = data_sync.handle_response(
-            p, s_a, nx_a, cx_a, st.weights, pay_in)
-    s_in = store_ops._sel(is_notify, s_n, store_ops._sel(is_response, s_r, s_a))
-    nx_in = store_ops._sel(is_response, nx_r, nx_a)
-    cx_in = store_ops._sel(is_response, cx_r, cx_a)
+    with scope("data_sync_handlers"):
+        if p.gate_handlers:
+            # lax.cond short-circuits the payload handlers behind the kind
+            # predicates: unbatched lowerings skip the wrong-kind subgraph
+            # entirely (the 16.6 ms handle_response graph runs for the ~5% of
+            # events that are responses); vmapped lowerings de-branch to the
+            # same per-leaf select the explicit _sel form used, so the
+            # trajectory is bit-identical either way.
+            s_n, should_sync = jax.lax.cond(
+                is_notify,
+                lambda: data_sync.handle_notification(
+                    p, s_a, st.weights, pay_in),
+                lambda: (s_a, jnp.bool_(False)))
+            s_r, nx_r, cx_r = jax.lax.cond(
+                is_response,
+                lambda: data_sync.handle_response(
+                    p, s_a, nx_a, cx_a, st.weights, pay_in),
+                lambda: (s_a, nx_a, cx_a))
+        else:
+            s_n, should_sync = data_sync.handle_notification(
+                p, s_a, st.weights, pay_in)
+            s_r, nx_r, cx_r = data_sync.handle_response(
+                p, s_a, nx_a, cx_a, st.weights, pay_in)
+        s_in = store_ops._sel(
+            is_notify, s_n, store_ops._sel(is_response, s_r, s_a))
+        nx_in = store_ops._sel(is_response, nx_r, nx_a)
+        cx_in = store_ops._sel(is_response, cx_r, cx_a)
 
-    s_u, pm_u, nx_u, cx_u, actions = node_ops.update_node(
-        p, s_in, pm_a, nx_in, cx_in, st.weights, a, local_clock, dur_table
-    )
+    with scope("node_update"):
+        s_u, pm_u, nx_u, cx_u, actions = node_ops.update_node(
+            p, s_in, pm_a, nx_in, cx_in, st.weights, a, local_clock, dur_table
+        )
     s_f = store_ops._sel(do_update, s_u, s_in)
     pm_f = store_ops._sel(do_update, pm_u, pm_a)
     nx_f = store_ops._sel(do_update, nx_u, nx_in)
@@ -376,15 +385,16 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     # serialize into per-kernel dispatch there; the payload form is a
     # matmul).  Bit-identical forms — see utils/xops.scatter_set.
     wmode = xops.backend_mode(p.dense_writes)
-    queue = queue.replace(
-        valid=scatter_set(queue.valid, tgt, True, mode=wmode),
-        time=scatter_set(queue.time, tgt, arrive, mode=wmode),
-        kind=scatter_set(queue.kind, tgt, kinds, mode=wmode),
-        stamp=scatter_set(queue.stamp, tgt, stamps, mode=wmode),
-        sender=scatter_set(queue.sender, tgt, a, mode=wmode),
-        receiver=scatter_set(queue.receiver, tgt, recvs, mode=wmode),
-        payload=scatter_set(queue.payload, tgt, out_pay, mode=wmode),
-    )
+    with scope("queue_route"):
+        queue = queue.replace(
+            valid=scatter_set(queue.valid, tgt, True, mode=wmode),
+            time=scatter_set(queue.time, tgt, arrive, mode=wmode),
+            kind=scatter_set(queue.kind, tgt, kinds, mode=wmode),
+            stamp=scatter_set(queue.stamp, tgt, stamps, mode=wmode),
+            sender=scatter_set(queue.sender, tgt, a, mode=wmode),
+            receiver=scatter_set(queue.receiver, tgt, recvs, mode=wmode),
+            payload=scatter_set(queue.payload, tgt, out_pay, mode=wmode),
+        )
 
     # ---- Timer reschedule (process_node_actions, simulator.rs:310-324).
     # sat_add: next_sched + startup without int32 wrap (== the wide-int
@@ -409,6 +419,54 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         trace_node, trace_round, trace_time = (
             st.trace_node, st.trace_round, st.trace_time)
 
+    # ---- Telemetry plane + flight recorder (telemetry/plane.py).  Every
+    # update is a fusion-friendly elementwise form over the [M] plane;
+    # compiled out entirely when SimParams.telemetry is off.
+    if p.telemetry:
+        with scope("telemetry"):
+            m = st.metrics
+            m = tplane.bump(p, m, "ev_notify", when=is_notify)
+            m = tplane.bump(p, m, "ev_request", when=is_request)
+            m = tplane.bump(p, m, "ev_response", when=is_response)
+            m = tplane.bump(p, m, "ev_timer", when=live & is_timer)
+            m = tplane.bump(p, m, "drops", jnp.sum(dropped), when=live)
+            m = tplane.bump(p, m, "overflow", jnp.sum(overflow), when=live)
+            m = tplane.bump(p, m, "sync_jumps",
+                            cx_f.sync_jumps - cx_a.sync_jumps, when=live)
+            # Queue pressure after this step's writes.
+            depth_n = jnp.sum(
+                queue.valid[:, None]
+                & (queue.receiver[:, None] == jnp.arange(n)[None, :]),
+                axis=0)
+            qtot = jnp.sum(queue.valid)
+            m = tplane.region_max(p, m, "node_depth_hwm", depth_n)
+            m = tplane.region_max(p, m, "queue_hwm", qtot)
+            # Round-switch latency: local-clock dwell time in the round the
+            # handled node just left (both round_starts are node-local).
+            rlat = jnp.maximum(pm_f.round_start - pm_a.round_start, 0)
+            m = tplane.bump_hist(p, m, "round_lat_hist", rlat[None],
+                                 switched[None])
+            # Proposal -> commit latency of the newest committed entry
+            # (global time; miss = block already rotated out of the window).
+            committed = live & (cx_f.commit_count > cx_a.commit_count)
+            cfound, clat = tplane.commit_latency(p, s_f, cx_f, st.startup,
+                                                 clock)
+            m = tplane.bump_hist(p, m, "commit_lat_hist", clat[None],
+                                 (committed & cfound)[None])
+            m = tplane.bump(p, m, "commit_lat_miss",
+                            when=committed & ~cfound)
+            # Flight recorder: one row per processed event, ring position
+            # from the plane's fr_count slot.
+            frc = tplane.read(p, m, "fr_count")
+            row = jnp.stack([kind, a, clock, s_f.current_round,
+                             qtot.astype(I32)])
+            flight = wset(st.flight, jnp.remainder(frc, p.flight_cap), row,
+                          when=live)
+            m = tplane.bump(p, m, "fr_count", when=live)
+        tel_updates = dict(metrics=m, flight=flight)
+    else:
+        tel_updates = {}
+
     if p.packed:
         # One plane-wide masked select replaces ~70 per-leaf writes.
         node_updates = dict(planes=wset(
@@ -422,6 +480,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         )
     return st.replace(
         **node_updates,
+        **tel_updates,
         queue=queue,
         ho_pay=ho_pay,
         ho_epoch=ho_epoch,

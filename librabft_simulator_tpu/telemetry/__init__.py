@@ -1,0 +1,18 @@
+"""Zero-sync observability for the TPU fleet.
+
+Three pieces, mirroring the split the rest of the codebase uses:
+
+* :mod:`.plane` — the device side: a fixed-shape int32 metrics plane
+  (``SimState.metrics``, one ``[M]`` vector per instance with a static slot
+  registry, the ``core/packing.py`` idiom applied to counters) plus a
+  last-K-events flight-recorder ring (``SimState.flight``, ``[K, 5]``).
+  Everything is gated by the static ``SimParams.telemetry`` flag: disabled,
+  the arrays are zero-width and every update compiles out, so the graph is
+  bit- and kernel-identical to a telemetry-free build.
+* :mod:`.report` — the host side: decode + merge metric planes, flight
+  rings, and ``analysis/data_writer.py`` output into one run-report dict
+  that ``bench.py`` and ``analysis/sweeps.py`` attach to their contract
+  lines.
+* :mod:`.profiling` — ``jax.named_scope`` annotations around the step's
+  phases so on-chip ``jax.profiler`` traces map to code regions.
+"""

@@ -1,0 +1,228 @@
+"""In-graph telemetry: the metrics plane and its static slot registry.
+
+The whole simulator is traced to XLA, so observability must itself be
+fixed-shape in-graph state with zero host sync in the hot loop.  This module
+applies ``core/packing.py``'s slot-map idiom to *metrics*: every counter,
+high-water mark, and histogram bucket of one instance lives in one flat
+``[M]`` int32 plane with a static (name -> offset) registry, and every
+update lowers to fusion-friendly elementwise forms:
+
+* counters bump via constant one-hot adds (``arange(M) == off`` folds at
+  compile time);
+* histogram buckets bump via small one-hot compares against a dynamic
+  offset;
+* high-water regions update via static-offset dynamic-slice / update-slice.
+
+No scalar scatters anywhere — the axon TPU stack miscompiles vmapped scalar
+scatters at fleet batch sizes (utils/xops.py), and telemetry must never be
+able to corrupt the run it observes.
+
+The flight recorder is a separate ``[K, FR_COLS]`` ring per instance
+(generalizing the round-switch ``trace_*`` ring): one row per processed
+event — (kind, actor, global time, actor's post-update round, queue depth)
+— with its running count stored in the plane's ``fr_count`` slot.  A fuzz
+divergence or on-chip anomaly thus yields a replayable tail instead of a
+bisection session (see scripts/fuzz_parity.py's minidump path).
+
+Everything is gated by the static ``SimParams.telemetry`` flag; disabled,
+the plane and ring are zero-width arrays and every update site is skipped
+at trace time, so the compiled graph is identical to a telemetry-free
+build (pinned by tests/test_telemetry.py + the kernel-census CI gate).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import quantile
+
+I32 = jnp.int32
+
+# Flight-recorder row layout.
+FR_KIND = 0    # event kind (KIND_* incl. timer)
+FR_ACTOR = 1   # handling node
+FR_TIME = 2    # global clock of the event
+FR_ROUND = 3   # actor's current_round after the update
+FR_DEPTH = 4   # queue/inbox occupancy after the step's writes
+FR_COLS = 5
+FR_NAMES = ("kind", "actor", "time", "round", "depth")
+
+# Slot aggregation kinds (how batched planes merge on the host).
+SUM = "sum"    # counters and histogram buckets: add across instances
+MAX = "max"    # high-water marks: max across instances
+
+
+@functools.lru_cache(maxsize=None)
+def registry(p_structural):
+    """Static slot registry for one instance's metrics plane.
+
+    Returns ``(slots, width)``: ``slots`` is a name-keyed dict of
+    ``(offset, size, agg)`` and ``width`` the total plane length M.  Keyed
+    on ``SimParams.structural()`` like core/packing.py's slot map; the
+    layout depends only on n_nodes (per-node depth region) and the
+    histogram width."""
+    n = p_structural.n_nodes
+    hb = quantile.HIST_BUCKETS
+    order = [
+        # Per-event-kind counters (live processed events; sum == n_events).
+        ("ev_notify", 1, SUM),
+        ("ev_request", 1, SUM),
+        ("ev_response", 1, SUM),
+        ("ev_timer", 1, SUM),
+        # Loss / anomaly tallies.
+        ("drops", 1, SUM),          # network drops (== n_msgs_dropped)
+        ("overflow", 1, SUM),       # queue/inbox overflow (== n_queue_full)
+        ("sync_jumps", 1, SUM),     # state-sync jumps across the fleet
+        # Queue pressure high-water marks (post-step occupancy).
+        ("queue_hwm", 1, MAX),          # total in-flight messages
+        ("node_depth_hwm", n, MAX),     # per-receiver depth
+        # Latency histograms (geometric buckets, utils/quantile.py).
+        ("round_lat_hist", hb, SUM),    # time spent in a round at switch
+        ("commit_lat_hist", hb, SUM),   # proposal -> commit, global time
+        ("commit_lat_miss", 1, SUM),    # commits whose block left the window
+        # Flight-recorder running count (ring lives in SimState.flight).
+        ("fr_count", 1, SUM),
+        # Parallel (lane) engine window health; zero under the serial engine.
+        ("windows", 1, SUM),        # conservative windows processed
+        ("horizon_stall", 1, SUM),  # nodes with work beyond the hz horizon
+        ("lane_spill", 1, SUM),     # qualifying nodes beyond the A lanes
+    ]
+    slots = {}
+    off = 0
+    for name, size, agg in order:
+        slots[name] = (off, size, agg)
+        off += size
+    return slots, off
+
+
+def width(p) -> int:
+    """Plane length M for these params (0 when telemetry is off)."""
+    if not p.telemetry:
+        return 0
+    return registry(p.structural())[1]
+
+
+def slot(p, name: str) -> tuple[int, int]:
+    """(offset, size) of a named slot — static Python ints."""
+    off, size, _ = registry(p.structural())[0][name]
+    return off, size
+
+
+def init_plane(p, shape=()):
+    """Zero plane ([M] per instance; [0] when telemetry is off)."""
+    return jnp.zeros(shape + (width(p),), I32)
+
+
+def init_flight(p, shape=()):
+    """Zero flight ring ([K, FR_COLS] per instance; K=0 when off)."""
+    k = p.flight_cap if p.telemetry else 0
+    return jnp.zeros(shape + (k, FR_COLS), I32)
+
+
+# ---------------------------------------------------------------------------
+# Device-side plane updates.  All take and return the [M] plane.
+# ---------------------------------------------------------------------------
+
+
+def bump(p, metrics, name: str, inc=1, when=None):
+    """Add ``inc`` to a size-1 slot (masked by ``when``): one-hot add with a
+    compile-time-constant mask."""
+    off, size = slot(p, name)
+    assert size == 1, name
+    inc = jnp.asarray(inc, I32)
+    if when is not None:
+        inc = jnp.where(when, inc, 0)
+    return metrics + jnp.where(jnp.arange(metrics.shape[-1]) == off, inc, 0)
+
+
+def bump_hist(p, metrics, name: str, samples, mask):
+    """Accumulate latency ``samples`` ([L] int32, masked by ``mask`` [L])
+    into a histogram region: per-sample geometric bucket, one-hot compare
+    against the (dynamic) bucket offsets, summed — no scatter."""
+    off, size = slot(p, name)
+    edges = jnp.asarray(quantile.histogram_edges(size))
+    b = jnp.sum(samples[:, None] >= edges[None, :], axis=1).astype(I32)
+    pos = off + jnp.clip(b, 0, size - 1)
+    onehot = (jnp.arange(metrics.shape[-1])[None, :] == pos[:, None]) \
+        & mask[:, None]
+    return metrics + jnp.sum(onehot.astype(I32), axis=0)
+
+
+def region_max(p, metrics, name: str, values):
+    """Elementwise max of a region against ``values`` ([size] int32):
+    static-offset slice / update-slice — the high-water-mark update."""
+    off, size = slot(p, name)
+    values = jnp.broadcast_to(jnp.asarray(values, I32), (size,))
+    cur = jax.lax.dynamic_slice(metrics, (off,), (size,))
+    return jax.lax.dynamic_update_slice(
+        metrics, jnp.maximum(cur, values), (off,))
+
+
+def read(p, metrics, name: str):
+    """Scalar read of a size-1 slot (static index)."""
+    off, size = slot(p, name)
+    assert size == 1, name
+    return metrics[off]
+
+
+def commit_latency(p, store, ctx, startup, clock):
+    """(found, latency) of the newest committed entry of one node.
+
+    The committed log records (round, depth, state tag) but not times; the
+    proposal time is recovered from the block table while the block is
+    still inside the round window: global proposal time = the block's
+    ``time`` (proposer-local) + the proposer's startup offset.  ``found``
+    is False when the block has rotated out (or the store was rebuilt by
+    an epoch switch / sync jump) — callers tally that as ``commit_lat_miss``
+    rather than guessing.  Variant ties (Byzantine equivocation at the
+    committed round) resolve to the lowest valid variant; the oracle
+    mirrors this exactly (oracle/sim.py), so the histograms stay
+    bit-comparable."""
+    pos = jnp.remainder(ctx.commit_count - 1, p.commit_log)
+    r_c = ctx.log_round[pos]
+    sl = jnp.remainder(r_c, p.window)
+    cand = store.blk_valid[sl] & (store.blk_round[sl] == r_c)
+    found = jnp.any(cand)
+    v = jnp.argmax(cand)  # lowest valid variant
+    author = jnp.clip(store.blk_author[sl, v], 0, p.n_nodes - 1)
+    t_prop = store.blk_time[sl, v] + startup[author]
+    return found, jnp.maximum(clock - t_prop, 0)
+
+
+def ring_order(count: int, cap: int) -> list:
+    """Chronological storage indices of a capacity-``cap`` append ring after
+    ``count`` appends, oldest surviving entry first.
+
+    Shared by every ring decoder (the flight recorder here, the round-switch
+    trace in analysis/data_writer.py): after overflow the oldest surviving
+    entry sits at ``count % cap``, and reading in storage order would
+    interleave stale and fresh entries.  An unused or disabled ring
+    (``cap == 0``) decodes to no entries."""
+    if cap <= 0:
+        return []
+    if count > cap:
+        start = count % cap
+        return [(start + i) % cap for i in range(cap)]
+    return list(range(count))
+
+
+def np_registry(p) -> dict:
+    """Host view of the registry: name -> (offset, size, agg)."""
+    return dict(registry(p.structural())[0])
+
+
+def np_width(p) -> int:
+    return int(registry(p.structural())[1])
+
+
+def decode(p, metrics_np: np.ndarray) -> dict:
+    """One instance's plane -> {name: int | list}."""
+    out = {}
+    for name, (off, size, _) in np_registry(p).items():
+        vals = metrics_np[off:off + size]
+        out[name] = int(vals[0]) if size == 1 else [int(v) for v in vals]
+    return out

@@ -1,0 +1,24 @@
+"""jax.profiler trace annotations for the step functions.
+
+``scope(name)`` wraps a tracing-time ``jax.named_scope``: the name lands in
+the HLO op metadata of everything traced inside it, so an on-chip
+``jax.profiler`` capture (when the TPU tunnel is up) groups kernels by code
+region — event selection, data-sync handlers, node update, queue routing,
+commit delivery — instead of one undifferentiated fusion soup.  Pure
+metadata: instruction counts, fusion decisions, and numerics are untouched
+(the kernel-census CI gate pins this), so the scopes are always on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def scope(name: str):
+    """Named tracing scope ``librabft/<name>`` (no-op off-trace)."""
+    try:
+        return jax.named_scope(f"librabft/{name}")
+    except Exception:  # pragma: no cover - ancient jax fallback
+        return contextlib.nullcontext()

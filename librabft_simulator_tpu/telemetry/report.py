@@ -1,0 +1,211 @@
+"""Host-side telemetry exporter: one run-report from metric planes, the
+flight recorder, and the DataWriter summary.
+
+Device state stays on device during the run (zero host sync in the hot
+loop); this module decodes everything AFTER the run:
+
+* :func:`metrics_dict` — one instance's ``[M]`` plane to named values;
+* :func:`merged_metrics` — a batched ``[B, M]`` plane folded across the
+  fleet per slot kind (counters/histograms sum, high-water marks max);
+* :func:`decode_flight` — the last-K-events ring in chronological order;
+* :func:`telemetry_block` — the compact block ``bench.py`` and
+  ``analysis/sweeps.py`` attach to their emitted contract lines (event-kind
+  counts, loss tallies, queue pressure, p50/p99 latency bounds);
+* :func:`run_report` — the full merged report (+ optional DataWriter files);
+* :func:`probe_occupancy` — the engine throughput/occupancy probe that used
+  to live in ``scripts/occupancy_probe.py``.
+
+Histogram quantiles are reported as ``(lo, hi)`` *bucket bounds*: the
+geometric buckets (utils/quantile.py) bound the true quantile rather than
+estimate it, which keeps the report honest about its own resolution.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..utils import quantile
+from . import plane
+
+
+def _metrics_np(st, instance: Optional[int] = None) -> np.ndarray:
+    m = np.asarray(jax.device_get(st.metrics))
+    if instance is not None:
+        m = m[instance]
+    return m
+
+
+def _require_one_instance(arr: np.ndarray, batched_ndim: int, what: str):
+    if arr.ndim > batched_ndim:
+        raise ValueError(
+            f"{what}: batched fleet state needs instance=<i> to pick one "
+            "instance (use merged_metrics/telemetry_block for fleet "
+            "aggregates)")
+
+
+def metrics_dict(p, st, instance: Optional[int] = None) -> dict:
+    """One instance's metrics plane as {slot name: int | list}."""
+    m = _metrics_np(st, instance)
+    _require_one_instance(m, 1, "metrics_dict")
+    return plane.decode(p, m)
+
+
+def merged_metrics(p, st) -> dict:
+    """Fold a (possibly batched) plane across all leading dims: counters and
+    histogram buckets sum over the fleet, high-water marks max."""
+    m = _metrics_np(st)
+    flat = m.reshape((-1, m.shape[-1])) if m.ndim > 1 else m[None]
+    out = {}
+    for name, (off, size, agg) in plane.np_registry(p).items():
+        vals = flat[:, off:off + size]
+        red = vals.max(axis=0) if agg == plane.MAX else vals.sum(axis=0)
+        out[name] = int(red[0]) if size == 1 else [int(v) for v in red]
+    return out
+
+
+def decode_flight(p, st, instance: Optional[int] = None) -> list[dict]:
+    """The flight-recorder tail, oldest first.
+
+    Serial-engine rows are strictly chronological; parallel-engine rows are
+    appended in (window, drain-iteration, lane) order — sort by ``time`` for
+    a per-node chronological view."""
+    if not p.telemetry:
+        return []
+    fl = np.asarray(jax.device_get(st.flight))
+    if instance is not None:
+        fl = fl[instance]
+    _require_one_instance(fl, 2, "decode_flight")
+    count = metrics_dict(p, st, instance)["fr_count"]
+    order = plane.ring_order(count, fl.shape[0])
+    return [
+        {name: int(fl[i, col]) for col, name in enumerate(plane.FR_NAMES)}
+        for i in order
+    ]
+
+
+def histogram_quantile(counts, q: float) -> tuple[int, int]:
+    """(lo, hi) bucket bounds containing the q-th sample of a histogram
+    (inverted-CDF rank: the ceil(q * total)-th sample).  (-1, -1) if empty;
+    ``hi`` of the open-ended last bucket is INT32_MAX."""
+    counts = np.asarray(counts, np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return (-1, -1)
+    rank = max(int(np.ceil(q * total)), 1)
+    b = int(np.searchsorted(np.cumsum(counts), rank))
+    edges = quantile.histogram_edges(len(counts))
+    lo = int(edges[b - 1]) if b > 0 else 0
+    hi = int(edges[b]) if b < len(edges) else 2**31 - 1
+    return (lo, hi)
+
+
+def _quantile_block(counts) -> dict:
+    p50 = histogram_quantile(counts, 0.50)
+    p99 = histogram_quantile(counts, 0.99)
+    return {"count": int(np.sum(counts)),
+            "p50_bounds": list(p50), "p99_bounds": list(p99)}
+
+
+def telemetry_block(p, st) -> dict:
+    """The compact fleet-level block for contract lines (bench.py JSON,
+    sweeps rows): event-kind counts, loss tallies, queue pressure, and
+    latency quantile bounds, merged across the whole batch."""
+    m = merged_metrics(p, st)
+    block = {
+        "events": {
+            "notify": m["ev_notify"], "request": m["ev_request"],
+            "response": m["ev_response"], "timer": m["ev_timer"],
+        },
+        "drops": m["drops"],
+        "overflow": m["overflow"],
+        "sync_jumps": m["sync_jumps"],
+        "queue_hwm": m["queue_hwm"],
+        "node_depth_hwm_max": max(m["node_depth_hwm"]) if m["node_depth_hwm"]
+        else 0,
+        "round_latency": _quantile_block(m["round_lat_hist"]),
+        "commit_latency": _quantile_block(m["commit_lat_hist"]),
+        "commit_lat_miss": m["commit_lat_miss"],
+        "fr_count": m["fr_count"],
+    }
+    if m["windows"]:  # lane-engine window health (parallel engine only)
+        block["windows"] = m["windows"]
+        block["horizon_stall"] = m["horizon_stall"]
+        block["lane_spill"] = m["lane_spill"]
+    return block
+
+
+def run_report(p, st, instance: Optional[int] = None,
+               data_dir: Optional[str] = None) -> dict:
+    """The unified run-report: DataWriter summary + merged metrics + the
+    decoded flight tail.  ``data_dir`` additionally writes the classic
+    DataWriter files (round_switches.txt etc.) there.
+
+    The DataWriter summary and the flight tail are per-instance artifacts
+    (DataWriter has always required ``instance`` for batched states), so a
+    batched fleet without ``instance`` reports fleet aggregates only
+    (merged metrics + telemetry block)."""
+    from ..analysis import data_writer as dw
+
+    batched = np.asarray(jax.device_get(st.clock)).ndim > 0
+    report = {}
+    if instance is not None or not batched:
+        if data_dir is not None:
+            report["summary"] = dw.DataWriter(p, data_dir).write(st, instance)
+        else:
+            report["summary"] = dw.summary_dict(p, st, instance)
+    if p.telemetry:
+        report["telemetry"] = telemetry_block(p, st)
+        if batched and instance is None:
+            report["metrics"] = merged_metrics(p, st)
+        else:
+            report["metrics"] = metrics_dict(p, st, instance)
+            report["flight"] = decode_flight(p, st, instance)
+        report["histogram_edges"] = [
+            int(e) for e in quantile.histogram_edges()]
+    return report
+
+
+def probe_occupancy(engine, p, B: int = 512, chunk: int = 32,
+                    reps: int = 3) -> dict:
+    """Engine throughput/occupancy probe (absorbed from
+    scripts/occupancy_probe.py): run ``reps`` timed chunks of ``chunk``
+    steps over a ``B``-instance fleet and report rates, overflow fraction,
+    and — when telemetry is on — the full telemetry block."""
+    from ..sim.simulator import dedupe_buffers
+
+    seeds = np.arange(B, dtype=np.uint32)
+    st = dedupe_buffers(engine.init_batch(p, seeds))
+    run = engine.make_run_fn(p, chunk)
+    t0 = time.perf_counter()
+    st = run(st)
+    jax.block_until_ready(st)
+    compile_s = time.perf_counter() - t0
+    g = lambda x: np.asarray(jax.device_get(x))  # noqa: E731
+    e0 = int(g(st.n_events).sum())
+    r0 = int((g(st.store.current_round).max(axis=-1) - 1).sum())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st = run(st)
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+    e1 = int(g(st.n_events).sum())
+    r1 = int((g(st.store.current_round).max(axis=-1) - 1).sum())
+    lost_f = st.n_queue_full if hasattr(st, "n_queue_full") else st.n_inbox_full
+    lost = int(g(lost_f).sum())
+    sent = int(g(st.n_msgs_sent).sum())
+    out = {
+        "events_per_sec": (e1 - e0) / dt,
+        "rounds_per_sec": (r1 - r0) / dt,
+        "occupancy": (e1 - e0) / max(chunk * reps * B, 1),
+        "compile_s": compile_s,
+        "elapsed_s": dt,
+        "overflow_frac": lost / max(lost + sent, 1),
+        "commits": int(g(st.ctx.commit_count).sum()),
+    }
+    if p.telemetry:
+        out["telemetry"] = telemetry_block(p, st)
+    return out

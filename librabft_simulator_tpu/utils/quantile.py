@@ -64,6 +64,34 @@ def sample_from_table_np(table: np.ndarray, u32: int) -> int:
     return int(table[(int(u32) & 0xFFFFFFFF) >> (32 - TABLE_BITS)])
 
 
+# -- latency histogram buckets (telemetry/plane.py) --------------------------
+#
+# The telemetry metrics plane records round-switch and proposal->commit
+# latencies as fixed-width geometric histograms: bucket b holds samples in
+# [edges[b-1], edges[b]) with edges 1, 2, 4, ... — integer powers of two, so
+# bucketing on device is a handful of compares (no float math, bit-identical
+# everywhere) and the dynamic range covers one event tick up to the longest
+# horizon any BASELINE config runs (2^14 ticks; larger samples land in the
+# open-ended last bucket).
+
+HIST_BUCKETS = 16
+
+
+def histogram_edges(n_buckets: int = HIST_BUCKETS) -> np.ndarray:
+    """Ascending bucket boundaries [1, 2, 4, ...] of length n_buckets - 1.
+
+    bucket(x) = #edges <= x  (i.e. ``np.searchsorted(edges, x, "right")``),
+    so bucket 0 is x < 1 (instantaneous) and the last bucket is open-ended."""
+    return (2 ** np.arange(n_buckets - 1)).astype(np.int32)
+
+
+def bucket_np(x, n_buckets: int = HIST_BUCKETS) -> np.ndarray:
+    """Host-side bucketing (oracle + report decode); mirrors the device's
+    ``sum(x >= edges)`` exactly."""
+    edges = histogram_edges(n_buckets)
+    return np.searchsorted(edges, np.asarray(x), side="right").astype(np.int64)
+
+
 def make_table(kind: str, **kw) -> np.ndarray:
     if kind == "lognormal":
         return lognormal_table(kw.get("mean", 10.0), kw.get("variance", 4.0))

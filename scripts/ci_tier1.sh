@@ -5,7 +5,13 @@
 # The census budget is the tpu_shape top-level fusion count recorded in
 # KERNEL_CENSUS_r06.json (205 at n=4/B=2048, CPU-lowering proxy) plus
 # ~7% headroom; a PR that pushes the serial step's kernel count back
-# above it fails here without needing the TPU tunnel.
+# above it fails here without needing the TPU tunnel.  The telemetry-on
+# graph (SimParams.telemetry) gets its own budget from the
+# tpu_shape_telemetry count recorded in KERNEL_CENSUS_r07.json (214 =
+# tpu_shape + 9 fusions for the metrics plane + flight recorder) plus the
+# same headroom — telemetry OFF must stay inside the original budget
+# (observability must cost zero kernels when disabled), telemetry ON must
+# stay bounded.
 #
 # The 870 s pytest timeout is EXPECTED on this container (the suite is
 # XLA-compile-bound: the PR-1 baseline is DOTS_PASSED=49 at the timeout
@@ -18,6 +24,7 @@ set -u
 cd "$(dirname "$0")/.."
 
 CENSUS_BUDGET=${CENSUS_BUDGET:-220}
+TELEMETRY_CENSUS_BUDGET=${TELEMETRY_CENSUS_BUDGET:-230}
 TIER1_MIN_DOTS=${TIER1_MIN_DOTS:-39}
 
 echo "=== collection check ==="
@@ -42,9 +49,10 @@ dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 fails=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd FE | wc -c)
 echo "DOTS_PASSED=${dots} FAILS=${fails} rc=${rc}"
 
-echo "=== kernel census regression gate (budget: ${CENSUS_BUDGET}) ==="
+echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
-    --assert-max "${CENSUS_BUDGET}"
+    --assert-max "${CENSUS_BUDGET}" \
+    --assert-telemetry-max "${TELEMETRY_CENSUS_BUDGET}"
 census_rc=$?
 
 tests_ok=0

@@ -1,7 +1,6 @@
 """Lockstep parity debugger: step JAX sim and oracle together, print first
 divergence in observable state."""
 
-import functools
 import sys
 
 sys.path.insert(0, ".")
@@ -9,7 +8,6 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 jax.config.update("jax_platforms", "cpu")
@@ -64,31 +62,58 @@ def snap_orc(o):
     )
 
 
-def main():
-    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 0
-    max_ev = int(sys.argv[2]) if len(sys.argv) > 2 else 900
-    p = SimParams(n_nodes=3, max_clock=1000)
-    delay_table = jnp.asarray(p.delay_table())
-    dur_table = jnp.asarray(p.duration_table())
-    step = jax.jit(functools.partial(S.step, p, delay_table, dur_table))
-    st = S.init_state(p, seed)
-    orc = OracleSim(p, seed)
+def diff_snaps(a: dict, b: dict) -> dict:
+    """Leaf-diff of two observable-state snapshots: {key: (jax, oracle)}
+    for every differing leaf (the helper scripts/fuzz_parity.py reuses for
+    its first-divergence minidump)."""
+    return {k: (a[k], b[k]) for k in a if a[k] != b[k]}
+
+
+def first_divergence(p: SimParams, seed: int, byz=None, max_ev: int = 5000):
+    """Step the jitted serial engine and the oracle in lockstep; return
+    ``{"event": i, "diffs": {...}}`` at the first observable divergence,
+    None if both run identically to halt, or ``{"exhausted": True,
+    "max_ev": N}`` if the event budget ran out first — exhaustion must be
+    distinguishable from a clean identical run, or a late divergence reads
+    as a passing replay.
+
+    ``byz`` maps init_state Byzantine-mask kwargs (byz_equivocate /
+    byz_silent / byz_forge_qc) to [N] bool lists, matching fuzz trials."""
+    kw = dict(byz or {})
+    # step_fn_partial (not raw S.step): it resolves the 'auto' lowering
+    # fields and keeps the SimState-in/SimState-out contract when
+    # p.packed is on — the FUZZ_PACKED=1 campaign's shape.
+    step = jax.jit(S.step_fn_partial(p))
+    st = S.init_state(p, seed, **{k: np.asarray(v) for k, v in kw.items()})
+    orc = OracleSim(p, seed, **{k: list(v) for k, v in kw.items()})
     a, b = snap_jax(st), snap_orc(orc)
-    assert a == b, f"init mismatch: { {k: (a[k], b[k]) for k in a if a[k] != b[k]} }"
+    if a != b:
+        return {"event": 0, "diffs": diff_snaps(a, b)}
     for i in range(max_ev):
         st = step(st)
         orc.step()
         a, b = snap_jax(st), snap_orc(orc)
         if a != b:
-            print(f"DIVERGED at event {i + 1}")
-            for k in a:
-                if a[k] != b[k]:
-                    print(f"  {k}: jax={a[k]} oracle={b[k]}")
-            return
+            return {"event": i + 1, "diffs": diff_snaps(a, b)}
         if a["halted"]:
-            print(f"both halted at event {i + 1}, identical")
-            return
-    print(f"no divergence in {max_ev} events")
+            return None
+    return {"exhausted": True, "max_ev": max_ev}
+
+
+def main():
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    max_ev = int(sys.argv[2]) if len(sys.argv) > 2 else 900
+    p = SimParams(n_nodes=3, max_clock=1000)
+    div = first_divergence(p, seed, max_ev=max_ev)
+    if div is None:
+        print("both halted, identical")
+        return
+    if div.get("exhausted"):
+        print(f"no divergence in {max_ev} events (budget exhausted)")
+        return
+    print(f"DIVERGED at event {div['event']}")
+    for k, (a_v, b_v) in div["diffs"].items():
+        print(f"  {k}: jax={a_v} oracle={b_v}")
 
 
 if __name__ == "__main__":

@@ -1,5 +1,12 @@
 """Differential fuzz: jitted serial engine vs the pure-Python oracle.
 
+A failing trial no longer just prints and vanishes: the failure path writes
+a first-divergence MINIDUMP artifact (FUZZ_MINIDUMP_<n>.json) with the
+seed, full SimParams, the differing observable-state leaves at the first
+diverging event (scripts/debug_parity.py's lockstep leaf-diff), and the
+telemetry flight-recorder tail of the failing run — a replayable record
+instead of a bisection session.
+
 The framework's core claim is bit-determinism across implementations; the
 test suite pins ~15 hand-picked configs.  This fuzzer covers the runtime-
 parameter space cheaply by exploiting ``SimParams.structural()``
@@ -19,6 +26,7 @@ FUZZ_PACKED=1) {trials, structural_shapes, failures[]}.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import random
@@ -26,6 +34,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # debug_parity
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -113,6 +122,48 @@ def one_trial(p: SimParams, seed: int, byz=None) -> list[str]:
     return errs
 
 
+def write_minidump(p: SimParams, seed: int, structural: dict, runtime: dict,
+                   byz, errs: list[str], index: int) -> str:
+    """First-divergence minidump for a failing trial.
+
+    Reuses scripts/debug_parity.py's lockstep leaf-diff to locate the first
+    diverging event, then reruns the trial with telemetry on to capture the
+    flight-recorder tail and metrics plane of the failing trajectory.  Each
+    piece is best-effort: a crash while diagnosing must not lose the parts
+    already gathered (or the original failure record)."""
+    import debug_parity
+
+    dump = dict(seed=seed, structural=structural, runtime=runtime, byz=byz,
+                errors=errs, params=dataclasses.asdict(p))
+    try:
+        # Event budget matches one_trial's run_to_completion ceiling
+        # (400 chunks x 256 steps), so a replay can never give up before
+        # the trial's own horizon; first_divergence marks exhaustion
+        # explicitly if it somehow does.
+        dump["first_divergence"] = debug_parity.first_divergence(
+            p, seed, byz=byz, max_ev=400 * 256)
+    except Exception as e:  # noqa: BLE001 - diagnostics must not mask the failure
+        dump["first_divergence_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        from librabft_simulator_tpu.telemetry import report as tel_report
+
+        p_tel = dataclasses.replace(p, telemetry=True, flight_cap=64)
+        kw = {k: np.asarray(v) for k, v in (byz or {}).items()}
+        st = S.run_to_completion(p_tel, S.init_state(p_tel, seed, **kw))
+        dump["flight_tail"] = tel_report.decode_flight(p_tel, st)
+        dump["telemetry"] = tel_report.metrics_dict(p_tel, st)
+    except Exception as e:  # noqa: BLE001
+        dump["flight_tail_error"] = f"{type(e).__name__}: {e}"[:300]
+    # Seed-keyed name: campaigns restart `index` at 0, and a later campaign
+    # must not overwrite an earlier one's forensic artifact (same seed =>
+    # same deterministic trial => identical dump, so that collision is
+    # harmless by construction).
+    path = f"FUZZ_MINIDUMP_{index:04d}_seed{seed}.json"
+    with open(path, "w") as f:
+        json.dump(dump, f, indent=1, default=str)
+    return path
+
+
 def main() -> int:
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
     deadline = time.time() + minutes * 60
@@ -146,8 +197,11 @@ def main() -> int:
         errs = one_trial(p, seed, byz)
         trials += 1
         if errs:
+            minidump = write_minidump(p, seed, structural, runtime, byz,
+                                      errs, len(failures))
             failures.append(dict(structural=structural, runtime=runtime,
-                                 seed=seed, byz=byz, errors=errs))
+                                 seed=seed, byz=byz, errors=errs,
+                                 minidump=minidump))
             print(json.dumps(failures[-1]), flush=True)
         if trials % 10 == 0:
             print(f"[fuzz] {trials} trials, {len(shapes_used)} shapes, "

@@ -131,6 +131,12 @@ MODES = {
     # Post-PR TPU lowering shape (xops.resolve_params on a TPU backend).
     "tpu_shape": dict(packed=True, dense_writes="dense",
                       gate_handlers=True),
+    # TPU shape + the telemetry plane/flight recorder (telemetry/plane.py).
+    # Telemetry OFF must leave tpu_shape untouched (the --assert-max gate);
+    # telemetry ON pays its own recorded budget (--assert-telemetry-max,
+    # KERNEL_CENSUS_r07.json) — the cost of observing must be bounded too.
+    "tpu_shape_telemetry": dict(packed=True, dense_writes="dense",
+                                gate_handlers=True, telemetry=True),
 }
 
 
@@ -143,6 +149,10 @@ def main() -> int:
     ap.add_argument("--assert-max", type=int, default=None,
                     help="exit nonzero if the tpu_shape fusion count "
                          "exceeds this budget (CI regression gate)")
+    ap.add_argument("--assert-telemetry-max", type=int, default=None,
+                    help="exit nonzero if the tpu_shape_telemetry fusion "
+                         "count exceeds this budget (CI regression gate; "
+                         "recorded in KERNEL_CENSUS_r07.json)")
     ap.add_argument("--out", default=None,
                     help="write the full census JSON here")
     args = ap.parse_args()
@@ -182,6 +192,11 @@ def main() -> int:
     if args.assert_max is not None and after > args.assert_max:
         print(f"FAIL: tpu_shape top-level fusion count {after} exceeds "
               f"budget {args.assert_max}", file=sys.stderr)
+        return 1
+    tel = out["modes"]["tpu_shape_telemetry"]["top_fusions"]
+    if args.assert_telemetry_max is not None and tel > args.assert_telemetry_max:
+        print(f"FAIL: tpu_shape_telemetry top-level fusion count {tel} "
+              f"exceeds budget {args.assert_telemetry_max}", file=sys.stderr)
         return 1
     return 0
 

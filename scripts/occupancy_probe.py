@@ -1,10 +1,17 @@
-"""Dev probe: window occupancy + throughput of the parallel engine vs serial.
+"""DEPRECATED dev probe: window occupancy + throughput, parallel vs serial.
+
+The measurement itself moved into the telemetry exporter —
+``librabft_simulator_tpu.telemetry.report.probe_occupancy`` — so sweeps and
+future tooling can call it directly; this script remains as a thin CLI
+wrapper (plus the timing-only ablation hooks, which monkeypatch internals
+and stay a dev-script concern).
 
 Run on CPU: JAX_PLATFORMS=cpu python scripts/occupancy_probe.py
+Env: PN (nodes) PB (batch) PCHUNK PREPS PDELAY PQCAP PDROP PA (lanes)
+PK (drain) ENGINES=parallel,serial ABLATE=<piece> PTEL=1 (telemetry block)
 """
 import os
 import sys
-import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
@@ -13,43 +20,25 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.sim import parallel_sim as P
 from librabft_simulator_tpu.sim import simulator as S
-from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+from librabft_simulator_tpu.telemetry import report as tel_report
 
 
 def probe(engine, name, p, B=512, chunk=None, reps=None):
     chunk = chunk or int(os.environ.get("PCHUNK", "32"))
     reps = reps or int(os.environ.get("PREPS", "3"))
-    seeds = np.arange(B, dtype=np.uint32)
-    st = dedupe_buffers(engine.init_batch(p, seeds))
-    run = engine.make_run_fn(p, chunk)
-    t0 = time.perf_counter()
-    st = run(st)
-    jax.block_until_ready(st)
-    compile_s = time.perf_counter() - t0
-    e0 = int(np.sum(jax.device_get(st.n_events)))
-    r0 = int(np.sum(np.max(jax.device_get(st.store.current_round), axis=-1) - 1))
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        st = run(st)
-    jax.block_until_ready(st)
-    dt = time.perf_counter() - t0
-    e1 = int(np.sum(jax.device_get(st.n_events)))
-    r1 = int(np.sum(np.max(jax.device_get(st.store.current_round), axis=-1) - 1))
-    lost_f = st.n_queue_full if hasattr(st, "n_queue_full") else st.n_inbox_full
-    lost = int(np.sum(jax.device_get(lost_f)))
-    sent = int(np.sum(jax.device_get(st.n_msgs_sent)))
-    com = int(np.sum(jax.device_get(st.ctx.commit_count)))
-    steps = chunk * reps * B
-    print(f"{name:10s} ev/s={(e1-e0)/dt:10.0f} rounds/s={(r1-r0)/dt:8.0f} "
-          f"occupancy={(e1-e0)/steps:5.2f} compile={compile_s:5.1f}s "
-          f"dt={dt:.2f}s ovf={lost/max(lost+sent,1):.3f} commits={com}")
+    r = tel_report.probe_occupancy(engine, p, B=B, chunk=chunk, reps=reps)
+    print(f"{name:10s} ev/s={r['events_per_sec']:10.0f} "
+          f"rounds/s={r['rounds_per_sec']:8.0f} "
+          f"occupancy={r['occupancy']:5.2f} compile={r['compile_s']:5.1f}s "
+          f"dt={r['elapsed_s']:.2f}s ovf={r['overflow_frac']:.3f} "
+          f"commits={r['commits']}")
+    if "telemetry" in r:
+        print(f"{'':10s} telemetry: {r['telemetry']}")
 
 
 def ablate(name):
@@ -107,7 +96,8 @@ if __name__ == "__main__":
         queue_cap=int(os.environ.get("PQCAP", str(max(32, 4 * n)))),
         drop_prob=float(os.environ.get("PDROP", "0")),
         active_lanes=int(os.environ.get("PA", "0")),
-        drain_k=int(os.environ.get("PK", "0")))
+        drain_k=int(os.environ.get("PK", "0")),
+        telemetry=os.environ.get("PTEL", "") == "1")
     for e in engines:
         probe({"parallel": P, "serial": S}[e], f"{e}{'/' + ab if ab else ''}",
               p, B=B)

@@ -56,6 +56,50 @@ def test_data_writer_parallel_engine(tmp_path):
         assert len(times) > 3
 
 
+def test_round_switch_table_wrapped_ring():
+    """Ring-overflow decode: when trace_count > trace_cap the surviving
+    last-T entries are rotated in storage (oldest at count % T); the decoder
+    must iterate chronologically or stale entries shadow fresh ones under
+    the first-write-wins rule."""
+    from types import SimpleNamespace
+
+    from librabft_simulator_tpu.analysis.data_writer import round_switch_table
+
+    p = SimParams(n_nodes=2, trace_cap=4)
+    # 6 switches appended to a cap-4 ring: entries 0,1 were overwritten by
+    # 4,5.  Storage order is [4, 5, 2, 3]; chronological order is 2,3,4,5.
+    # Node 0 entered round 1 at t=12 (entry 2) and round 1 AGAIN at t=40
+    # (entry 4, e.g. after a sync-jump re-entry): first-write-wins must
+    # record t=12, which only happens if decode starts at count % T == 2.
+    st = SimpleNamespace(
+        trace_node=np.array([0, 1, 0, 1]),
+        trace_round=np.array([1, 2, 1, 1]),
+        trace_time=np.array([40, 50, 12, 13]),
+        trace_count=np.array(6),
+    )
+    table = round_switch_table(p, st)
+    assert table[1, 0] == 12  # chronological first entry, not the stale 40
+    assert table[1, 1] == 13
+    assert table[2, 1] == 50
+    # Tracing off (trace_cap == 0): trace_count still advances in both
+    # engines, and the decode must return the empty table, not divide by
+    # the zero capacity.
+    p0 = SimParams(n_nodes=2, trace_cap=0)
+    st0 = SimpleNamespace(
+        trace_node=np.zeros(0, np.int32), trace_round=np.zeros(0, np.int32),
+        trace_time=np.zeros(0, np.int32), trace_count=np.array(36))
+    assert round_switch_table(p0, st0).shape == (1, 2)
+    # Unwrapped ring (count <= cap) keeps the plain in-order decode.
+    st2 = SimpleNamespace(
+        trace_node=np.array([0, 1, 0, 0]),
+        trace_round=np.array([1, 1, 2, 2]),
+        trace_time=np.array([5, 6, 9, 11]),
+        trace_count=np.array(3),
+    )
+    table2 = round_switch_table(p, st2)
+    assert table2[1, 0] == 5 and table2[1, 1] == 6 and table2[2, 0] == 9
+
+
 def test_round_plotter_ascii_and_png(tmp_path, capsys):
     p, st = run_traced()
     DataWriter(p, str(tmp_path)).write(st)

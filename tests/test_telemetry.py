@@ -1,0 +1,172 @@
+"""telemetry/: the in-graph metrics plane + flight recorder.
+
+Three contracts (the acceptance referees of the observability PR):
+
+(a) telemetry OFF is free and inert: the state's telemetry leaves are
+    zero-width and a telemetry-ON run is bit-identical to the OFF run on
+    every common leaf — observing the fleet must never perturb it (the
+    engine-identity pattern from tests/test_packing.py; the kernel-census
+    CI gate separately pins that the OFF *graph* is unchanged).
+(b) counters match the pure-Python oracle's event tallies exactly on a
+    seeded run — including the flight-recorder tail row-for-row.
+(c) histograms match numpy-bucketed raw latencies, and the reported
+    quantile bounds bracket numpy's inverted-CDF quantiles.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.oracle.sim import OracleSim
+from librabft_simulator_tpu.sim import parallel_sim as P
+from librabft_simulator_tpu.sim import simulator as S
+from librabft_simulator_tpu.telemetry import plane as tplane
+from librabft_simulator_tpu.telemetry import report as treport
+from librabft_simulator_tpu.utils import quantile as Q
+
+# trace_cap matches across the pair: the round-switch trace ring is a
+# pre-existing feature whose shape must not confound the telemetry
+# on-vs-off identity comparison.
+P_OFF = SimParams(n_nodes=3, max_clock=400, trace_cap=256)
+P_ON = dataclasses.replace(P_OFF, telemetry=True, flight_cap=64)
+
+
+def strip_tel(st):
+    """Project out the telemetry leaves so ON and OFF states compare."""
+    return st.replace(metrics=jnp.zeros((0,), jnp.int32),
+                      flight=jnp.zeros((0, tplane.FR_COLS), jnp.int32))
+
+
+def assert_trees_equal(a, b):
+    flat_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert len(flat_a) == len(flat_b)
+    for (pt, la), (_, lb) in zip(flat_a, flat_b):
+        path = "/".join(str(q) for q in pt)
+        assert la.dtype == lb.dtype, path
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb), path)
+
+
+def test_registry_layout():
+    slots, width = tplane.registry(P_ON.structural())
+    assert width == tplane.width(P_ON) > 0
+    # Offsets tile the plane exactly, in registration order.
+    off = 0
+    for name, (o, size, agg) in slots.items():
+        assert o == off, name
+        assert size >= 1 and agg in (tplane.SUM, tplane.MAX)
+        off += size
+    assert off == width
+    # Per-node region scales with the fleet width.
+    assert tplane.slot(P_ON, "node_depth_hwm")[1] == P_ON.n_nodes
+    # Off params have a zero-width plane and ring.
+    assert tplane.width(P_OFF) == 0
+    assert tplane.init_flight(P_OFF).shape == (0, tplane.FR_COLS)
+
+
+def test_telemetry_off_is_inert_serial():
+    """(a) for the serial engine: OFF state carries zero-width telemetry
+    leaves; ON run is bit-identical to the OFF run on every common leaf."""
+    a = S.run_to_completion(P_OFF, S.init_state(P_OFF, 0))
+    b = S.run_to_completion(P_ON, S.init_state(P_ON, 0))
+    assert a.metrics.shape == (0,)
+    assert a.flight.shape == (0, tplane.FR_COLS)
+    assert b.metrics.shape == (tplane.width(P_ON),)
+    assert_trees_equal(strip_tel(a), strip_tel(b))
+    assert min(int(c) for c in a.ctx.commit_count) > 0  # non-trivial run
+
+
+def test_counters_and_flight_match_oracle():
+    """(b): every plane slot the oracle mirrors (event-kind counts, queue
+    high-water marks, commit-latency misses) matches its tallies exactly,
+    the loss/jump slots match the state counters they shadow, and the
+    flight-recorder tail equals the oracle's event log row-for-row."""
+    seed = 5
+    st = S.run_to_completion(P_ON, S.init_state(P_ON, seed))
+    orc = OracleSim(P_ON, seed).run()
+    md = treport.metrics_dict(P_ON, st)
+    ev = [md["ev_notify"], md["ev_request"], md["ev_response"],
+          md["ev_timer"]]
+    assert ev == orc.tel["ev_kind"]
+    assert sum(ev) == orc.n_events == int(st.n_events)
+    assert md["fr_count"] == orc.n_events
+    assert md["drops"] == orc.n_msgs_dropped
+    assert md["overflow"] == orc.n_queue_full
+    assert md["sync_jumps"] == sum(c.sync_jumps for c in orc.ctxs)
+    assert md["queue_hwm"] == orc.tel["queue_hwm"] > 0
+    assert md["node_depth_hwm"] == orc.tel["node_depth_hwm"]
+    assert md["commit_lat_miss"] == orc.tel["commit_lat_miss"]
+    # Flight tail: last K oracle events, byte-for-byte, oldest first.
+    tail = treport.decode_flight(P_ON, st)
+    assert len(tail) == min(P_ON.flight_cap, orc.n_events)
+    assert tail == orc.tel["flight"][-len(tail):]
+
+
+def test_histograms_match_numpy_quantiles():
+    """(c): device histograms equal numpy-bucketed oracle latencies, and the
+    reported p50/p99 bucket bounds bracket numpy's inverted-CDF quantiles
+    of the raw samples."""
+    seed = 11
+    st = S.run_to_completion(P_ON, S.init_state(P_ON, seed))
+    orc = OracleSim(P_ON, seed).run()
+    md = treport.metrics_dict(P_ON, st)
+    for hist_name, lats in [("round_lat_hist", orc.tel["round_lats"]),
+                            ("commit_lat_hist", orc.tel["commit_lats"])]:
+        assert len(lats) > 0, hist_name
+        expect = np.bincount(Q.bucket_np(lats), minlength=Q.HIST_BUCKETS)
+        assert md[hist_name] == [int(v) for v in expect], hist_name
+        for q in (0.50, 0.99):
+            lo, hi = treport.histogram_quantile(md[hist_name], q)
+            v = float(np.percentile(lats, 100 * q, method="inverted_cdf"))
+            assert lo <= v < hi or (hi == 2**31 - 1 and v >= lo), \
+                (hist_name, q, lo, v, hi)
+
+
+def test_histogram_quantile_edge_cases():
+    assert treport.histogram_quantile(np.zeros(Q.HIST_BUCKETS), 0.5) == (-1, -1)
+    counts = np.zeros(Q.HIST_BUCKETS, np.int64)
+    counts[0] = 3
+    assert treport.histogram_quantile(counts, 0.5) == (0, 1)
+    counts[-1] = 1  # open-ended last bucket
+    assert treport.histogram_quantile(counts, 0.99)[1] == 2**31 - 1
+
+
+def test_run_report_merges_data_writer(tmp_path):
+    st = S.run_to_completion(P_ON, S.init_state(P_ON, 3))
+    rep = treport.run_report(P_ON, st, data_dir=str(tmp_path))
+    assert (tmp_path / "round_switches.txt").exists()
+    assert rep["summary"]["n_events"] == int(st.n_events)
+    assert rep["telemetry"]["events"]["timer"] > 0
+    assert len(rep["flight"]) > 0
+    assert rep["histogram_edges"] == [int(e) for e in Q.histogram_edges()]
+    ev = rep["telemetry"]["events"]
+    assert sum(ev.values()) == rep["summary"]["n_events"]
+
+
+@pytest.mark.slow  # two fresh parallel-engine compiles (~minutes on CPU);
+# tier-1 telemetry coverage rides the serial tests above — the plane update
+# code is shared, only the lane-wise accumulation differs.
+def test_telemetry_off_is_inert_parallel():
+    p_off = SimParams(n_nodes=4, max_clock=300, epoch_handoff=False)
+    p_on = dataclasses.replace(p_off, telemetry=True, flight_cap=32)
+    a = P.run_to_completion(p_off, P.init_state(p_off, 1), chunk=16)
+    b = P.run_to_completion(p_on, P.init_state(p_on, 1), chunk=16)
+    assert_trees_equal(strip_tel(a), strip_tel(b))
+    md = treport.metrics_dict(p_on, b)
+    ev_sum = (md["ev_notify"] + md["ev_request"] + md["ev_response"]
+              + md["ev_timer"])
+    assert ev_sum == int(b.n_events) == md["fr_count"]
+    assert md["windows"] > 0
+    assert md["drops"] == int(b.n_msgs_dropped)
+    assert md["overflow"] == int(b.n_inbox_full)
+    tail = treport.decode_flight(p_on, b)
+    assert len(tail) == min(p_on.flight_cap, ev_sum)
+    # Lane rows land (window, iteration, lane)-ordered; per actor the event
+    # times are still monotone.
+    for actor in range(p_on.n_nodes):
+        times = [r["time"] for r in tail if r["actor"] == actor]
+        assert times == sorted(times)
