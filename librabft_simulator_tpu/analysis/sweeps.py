@@ -63,7 +63,12 @@ def _fleet_stats(p: SimParams, st, elapsed: float) -> dict:
 
 
 def run_config(p: SimParams, n_instances: int, seed0: int = 0,
-               f: int = 0, byz_kind: str = "equivocate", engine=S) -> dict:
+               f: int = 0, byz_kind: str = "equivocate", engine=S,
+               dp: int = 0) -> dict:
+    """``dp > 0`` runs the config on a dp-shard device mesh via the
+    pipelined fleet runtime (parallel/sharded.py): the instance batch is
+    padded to the device count with pre-halted instances (zero effect on
+    every reported stat) and each shard dispatches its own chunk loop."""
     seeds = np.arange(seed0, seed0 + n_instances, dtype=np.uint32)
     if f > 0:
         if engine is not S:
@@ -74,10 +79,31 @@ def run_config(p: SimParams, n_instances: int, seed0: int = 0,
         st = B.init_fault_batch(p, seeds, f, byz_kind)
     else:
         st = engine.init_batch(p, seeds)
-    t0 = time.perf_counter()
-    st = engine.run_to_completion(p, st, batched=True)
-    elapsed = time.perf_counter() - t0
+    if dp > 0:
+        from ..parallel import mesh as mesh_ops
+        from ..parallel import sharded
+
+        mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1,
+                                  devices=jax.devices()[:dp])
+        # Mirror run_to_completion's own default budget (RUN_CHUNK x
+        # RUN_MAX_CHUNKS) so dp and non-dp rows of one sweep run under
+        # identical step caps and their stats stay comparable.
+        chunk = engine.RUN_CHUNK
+        t0 = time.perf_counter()
+        st = sharded.run_sharded(
+            p, mesh, st, num_steps=chunk * engine.RUN_MAX_CHUNKS,
+            chunk=chunk, engine=engine)
+        # The pipelined loop returns with the last chunk possibly still in
+        # flight; sync before reading the clock or elapsed understates.
+        jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+        elapsed = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        st = engine.run_to_completion(p, st, batched=True)
+        elapsed = time.perf_counter() - t0
     out = _fleet_stats(p, st, elapsed)
+    if dp > 0:
+        out["dp"] = dp
     if f > 0:
         honest = np.arange(p.n_nodes) >= f
         out["f"] = f
@@ -116,19 +142,21 @@ def baseline_configs(scale: float = 1.0) -> dict:
 
 
 def run_all(scale: float = 1.0, out_path: str | None = None,
-            telemetry: bool = False) -> dict:
+            telemetry: bool = False, dp: int = 0) -> dict:
     results = {}
     for name, (p, n, f_mode) in baseline_configs(scale).items():
         if telemetry:
             p = dataclasses.replace(p, telemetry=True)
         if f_mode == "sweep":
+            # f > 0 batches stay on the single-device serial path (see
+            # run_config); the dp mesh applies to the plain fleet configs.
             results[name] = [
                 dataclasses.asdict(r)
                 for r in B.f_sweep(p, n, f_values=list(range(p.n_nodes // 3 + 1)))
             ]
         else:
             results[name] = run_config(
-                p, n, engine=P if f_mode == "parallel" else S)
+                p, n, engine=P if f_mode == "parallel" else S, dp=dp)
         print(f"[sweep] {name}: done", file=sys.stderr)
     if out_path:
         with open(out_path, "w") as f:
@@ -144,6 +172,11 @@ def main(argv=None):
     ap.add_argument("--telemetry", action="store_true",
                     help="run with SimParams.telemetry on and attach the "
                          "merged telemetry block to every sweep row")
+    ap.add_argument("--dp", type=int, default=0,
+                    help="run the fleet configs dp-sharded over this many "
+                         "devices (parallel/sharded.py pipelined runtime; "
+                         "on CPU force virtual devices with XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=N)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="pin the jax backend (the environment's TPU plugin "
                          "ignores JAX_PLATFORMS and hangs ~25 min when its "
@@ -157,7 +190,8 @@ def main(argv=None):
         print("[sweep] tpu tunnel relay not listening; pinning cpu",
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
-    results = run_all(args.scale, args.out, telemetry=args.telemetry)
+    results = run_all(args.scale, args.out, telemetry=args.telemetry,
+                      dp=args.dp)
     print(json.dumps(results, indent=2))
 
 

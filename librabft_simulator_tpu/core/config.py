@@ -11,28 +11,55 @@ than the whole N-element sum).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..utils import hashing as H
 
+#: Mesh axis the author dimension shards over (parallel/mesh.py).  Quorum
+#: aggregations psum partial sums over it when ``SimParams.mp_authors`` is
+#: on — the very-large-committee (N >> 64) scale-out path, where one chip
+#: shouldn't hold the whole author axis.
+MP_AXIS = "mp"
 
-def total_votes(weights):
-    return jnp.sum(weights, axis=-1)
+
+def mp_axis(p) -> str | None:
+    """The axis name the quorum aggregations reduce over for these params
+    (None = single-chip author math, the default).  When it returns
+    ``MP_AXIS`` the caller must be tracing inside a ``shard_map`` (or other
+    axis-binding transform) that binds 'mp' with the author tables sharded
+    over it — see parallel/sharded.py."""
+    return MP_AXIS if getattr(p, "mp_authors", False) else None
 
 
-def quorum_threshold(weights):
+def _psum(x, axis_name):
+    return jax.lax.psum(x, axis_name) if axis_name else x
+
+
+def total_votes(weights, axis_name=None):
+    return _psum(jnp.sum(weights, axis=-1), axis_name)
+
+
+def quorum_threshold(weights, axis_name=None):
     """2N/3 + 1 (configuration.rs:52-56)."""
-    return 2 * total_votes(weights) // 3 + 1
+    return 2 * total_votes(weights, axis_name) // 3 + 1
 
 
-def validity_threshold(weights):
+def validity_threshold(weights, axis_name=None):
     """(N + 2) / 3 (configuration.rs:58-62)."""
-    return (total_votes(weights) + 2) // 3
+    return (total_votes(weights, axis_name) + 2) // 3
 
 
-def count_votes(weights, author_mask):
-    """Sum of voting rights over a boolean author mask (configuration.rs:43)."""
-    return jnp.sum(jnp.where(author_mask, weights, 0), axis=-1)
+def count_votes(weights, author_mask, axis_name=None):
+    """Sum of voting rights over a boolean author mask (configuration.rs:43).
+
+    With ``axis_name`` the author axis is sharded over that mesh axis: each
+    shard sums its local authors and the psum rides ICI.  This one function
+    is both the single-chip quorum check and the mp-sharded one
+    (parallel/sharded.py wraps it in shard_map; the step's quorum sites in
+    core/store.py arm it via :func:`mp_axis`)."""
+    return _psum(jnp.sum(jnp.where(author_mask, weights, 0), axis=-1),
+                 axis_name)
 
 
 def pick_author(weights, seed_u32):

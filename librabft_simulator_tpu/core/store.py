@@ -407,7 +407,8 @@ def insert_vote(p: SimParams, s: Store, weights, v: VoteMsg):
         bal_state_depth=wset(s2.bal_state_depth, (bvar, slot), v.state_depth),
         bal_state_tag=wset(s2.bal_state_tag, (bvar, slot), v.state_tag),
     )
-    won = do_ballot & (new_weight >= config.quorum_threshold(weights))
+    won = do_ballot & (new_weight >= config.quorum_threshold(
+        weights, config.mp_axis(p)))
     s3 = s3.replace(
         election=jnp.where(won, _i32(ELECTION_WON), s3.election),
         won_var=jnp.where(won, bvar, s3.won_var),
@@ -450,7 +451,8 @@ def insert_qc(p: SimParams, s: Store, weights, q: QcMsg):
     state_match = exec_ok & (st_d == q.state_depth) & (st_t == q.state_tag)
     in_window = q.round > s.current_round - p.window
     vote_w, authors_known = mask_weight(p, weights, q.votes_lo, q.votes_hi)
-    quorum_ok = authors_known & (vote_w >= config.quorum_threshold(weights))
+    quorum_ok = authors_known & (vote_w >= config.quorum_threshold(
+        weights, config.mp_axis(p)))
     tag_ok = q.tag == qc_tag(
         q.epoch, q.round, q.blk_tag, q.state_depth, q.state_tag,
         q.commit_valid, q.commit_depth, q.commit_tag,
@@ -509,7 +511,7 @@ def insert_timeout(p: SimParams, s: Store, weights, t_epoch, t_round, t_hcbr, t_
         to_hcbr=wset(s.to_hcbr, author, t_hcbr),
         to_weight=new_weight,
     )
-    tc = new_weight >= config.quorum_threshold(weights)
+    tc = new_weight >= config.quorum_threshold(weights, config.mp_axis(p))
     s3 = s2.replace(
         tc_valid=s2.to_valid,
         tc_hcbr=s2.to_hcbr,

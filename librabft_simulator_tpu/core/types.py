@@ -133,6 +133,17 @@ class SimParams:
     # dots (measured: 35 vs 39 in the 870 s gate), outweighing its ~10%
     # batched-runtime win, so the CPU graph stays exactly the pre-PR one.
     gate_handlers: bool | None = None
+    # Author-dim (mp) quorum aggregation: when True, every quorum-weight
+    # reduction in core/store.py (ballot wins, insert_qc vote-set
+    # re-verification, TC formation) psums its local partial over the
+    # mesh's 'mp' axis via core/config.py — the same code path
+    # parallel/sharded.sharded_count_votes exercises standalone.  Requires
+    # tracing inside a shard_map that binds 'mp'; with n_mp == 1 the psum
+    # degenerates to the identity and trajectories are bit-identical to
+    # the default (tests/test_multichip.py pins this).  Sharding the [N]
+    # author *state tables* over mp (the N >> 64 regime) is future work —
+    # today n_mp > 1 is for the standalone quorum helpers.
+    mp_authors: bool = False
     # Network.
     shuffle_receivers: bool = False  # seeded per-event receiver permutation
                                      # (simulator.rs:343 fuzzing semantics);

@@ -21,11 +21,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def make_mesh(n_dp: int | None = None, n_mp: int = 1, devices=None) -> Mesh:
-    """A ('dp', 'mp') mesh over the available devices."""
+    """A ('dp', 'mp') mesh over the available devices.
+
+    Raises a clear :class:`ValueError` when the requested shape doesn't fit
+    the visible devices (a silent ``reshape`` of a short device array would
+    otherwise surface as an opaque numpy error deep in mesh construction)."""
     if devices is None:
         devices = jax.devices()
+    if n_mp < 1:
+        raise ValueError(f"n_mp must be >= 1, got {n_mp}")
     if n_dp is None:
         n_dp = len(devices) // n_mp
+    if n_dp < 1:
+        raise ValueError(
+            f"n_dp must be >= 1, got {n_dp} ({len(devices)} devices visible "
+            f"for n_mp={n_mp})")
+    if n_dp * n_mp > len(devices):
+        raise ValueError(
+            f"mesh shape dp={n_dp} x mp={n_mp} needs {n_dp * n_mp} devices "
+            f"but only {len(devices)} are visible (on CPU, force virtual "
+            "devices with XLA_FLAGS=--xla_force_host_platform_device_count=N)")
     devices = np.asarray(devices[: n_dp * n_mp]).reshape(n_dp, n_mp)
     return Mesh(devices, axis_names=("dp", "mp"))
 

@@ -1,79 +1,301 @@
-"""Sharded execution paths: dp over instances, mp over the author dimension.
+"""Sharded fleet runtime: dp over instances, mp over the author dimension.
 
-Two multi-chip strategies (usable together on a ('dp', 'mp') mesh):
+The round-5 on-chip data showed a single chip's step is kernel-dispatch
+bound — events/s is flat in B (PERF_NOTES.md) — and the remote-compile
+helper caps on-chip fleets at B=32768 anyway (ROADMAP).  The remaining
+throughput lever is therefore MORE DISPATCH ENGINES: shards share no state
+(the Chandy–Misra decomposition the lane engine already exploits is
+per-instance here), so SPMD over the 'dp' mesh axis is collective-free and
+scales with the chip count.  This module is the production runtime for
+that:
 
-* **dp (instance parallelism)** — the default scale-out: the [B, ...] batch is
-  split across chips; the jitted vmapped step needs no cross-instance
-  communication, so XLA compiles a collective-free SPMD program.
+* **Pipelined dispatch** (:func:`run_sharded`): the compiled chunk returns
+  an in-graph ``halted_count`` int32 scalar (one word to the host per
+  chunk, never the ``[B]`` halt plane), and the host loop is
+  double-buffered — chunk *k+1* is enqueued before chunk *k*'s scalar is
+  polled, so poll latency overlaps device compute.  Buffer donation
+  threads the fleet state in place between chunks (at B=100k the ~3.4 GB
+  state is never copied).
+* **Fleet semantics**: :func:`pad_to_multiple` pads B to the device count
+  with pre-halted instances (every engine write is gated on
+  ``live = ~halted``, so padding contributes zero events, telemetry, and
+  DataWriter traces); :func:`fleet_seeds` folds per-instance PRNG streams
+  from one base seed, identically for every dp layout, so a fleet is
+  reproducible however it is sharded.
+* **shard_map step wrapping** (:func:`make_sharded_run_fn`): the engine's
+  chunk scan runs under ``shard_map``, so each shard compiles to its own
+  independent while loop over its local batch — per-shard dispatch with no
+  partitioner-inserted resharding possible.  ``wrap="jit"`` keeps the
+  GSPMD-partitioned form for A/B.
+* **mp (author parallelism)**: quorum aggregation (configuration.rs:43
+  ``count_votes``) for very large committees (N >> 64) shards the author
+  axis over 'mp'.  The aggregation itself lives in ``core/config.py`` and
+  is armed inside the step's real quorum checks by
+  ``SimParams.mp_authors`` (core/store.py ballot/insert_qc/TC sites);
+  :func:`sharded_count_votes` / :func:`sharded_quorum_reached` wrap that
+  same implementation in shard_map for standalone use.  Sharding the [N]
+  author *state tables* is future work — today n_mp > 1 is for the
+  standalone helpers, and ``mp_authors`` runs degenerate-identical at
+  n_mp == 1 (tests/test_multichip.py).
 
-* **mp (author parallelism)** — inside an instance, per-author tables
-  (votes, timeouts, weights: the [N] axes) are split over 'mp'; quorum
-  aggregation (configuration.rs:43 ``count_votes``) becomes a
-  ``psum`` over the mp axis.  This is the pattern for very large committees
-  (N ≫ 64) where one chip's HBM or vector lanes shouldn't hold the whole
-  author axis.  Exposed as :func:`sharded_count_votes` /
-  :func:`sharded_quorum_reached` and exercised by ``dryrun_multichip``.
+XLA inserts all collectives; on real hardware the dp axis should map to
+ICI-adjacent devices (default device order does this on TPU slices).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from ..core import config
 from ..core.types import SimParams
 from ..sim import simulator as sim_ops
+from ..utils import hashing as H
+from ..utils import xops
 from . import mesh as mesh_ops
+
+I32 = jnp.int32
+
+#: Filler-seed salt for pad instances (golden-ratio constant): pad seeds
+#: are folded from a DIFFERENT base than any caller's fleet_seeds(0, ...),
+#: so a padded fleet can never alias a real instance's rng stream.
+_PAD_SALT = 0x9E3779B9
+
+
+def fleet_seeds(base_seed: int, n: int, start: int = 0) -> np.ndarray:
+    """Per-instance PRNG streams folded from one base seed.
+
+    Instance *i*'s seed is ``mix32(base_seed, start + i)`` — a pure
+    function of the GLOBAL instance index, so the streams are identical for
+    every dp layout (1 chip or 64) and a sharded fleet reproduces an
+    unsharded one bit-for-bit.  ``start`` lets per-shard hosts derive their
+    local slice without materializing the full seed vector."""
+    idx = jnp.arange(start, start + n, dtype=jnp.uint32)
+    return np.asarray(jax.vmap(
+        lambda i: H.mix32(jnp.uint32(base_seed), i))(idx))
+
+
+def batch_size(state) -> int:
+    """Leading (instance) dim of a batched engine state."""
+    return int(jax.tree_util.tree_leaves(state)[0].shape[0])
+
+
+def pad_to_multiple(p: SimParams, state, multiple: int, engine=None):
+    """Pad the fleet's batch dim to a multiple of ``multiple`` with
+    PRE-HALTED instances; returns ``(padded_state, n_valid)``.
+
+    Padded instances are freshly initialised from salted filler seeds and
+    start with ``halted=True``: both engines gate every write on
+    ``live = ~halted``, so padding processes no events, sends no messages,
+    and leaves its metrics plane, flight ring, and DataWriter trace ring
+    all-zero — arithmetic ballast only, masked out of every observable by
+    construction (tests/test_multichip.py pins this against the oracle).
+    A host (numpy) tree pads on host — numpy concat, filler fetched — so
+    checkpoint restores never stage full leaves on the default device."""
+    eng = engine if engine is not None else sim_ops
+    b = batch_size(state)
+    pad = (-b) % max(int(multiple), 1)
+    if pad == 0:
+        return state, b
+    filler = eng.init_batch(p, fleet_seeds(_PAD_SALT, pad, start=b))
+    filler = filler.replace(halted=jnp.ones((pad,), jnp.bool_))
+    if isinstance(jax.tree_util.tree_leaves(state)[0], np.ndarray):
+        filler = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), filler)
+        cat = lambda a, x: np.concatenate([a, x.astype(a.dtype)], axis=0)  # noqa: E731
+    else:
+        cat = lambda a, x: jnp.concatenate([jnp.asarray(a), x], axis=0)  # noqa: E731
+    return jax.tree.map(cat, state, filler), b
+
+
+def unpad(state, n_valid: int):
+    """Drop the pad instances appended by :func:`pad_to_multiple`.
+
+    A dp-sharded fleet lands shard-by-shard on HOST (numpy tree): the
+    trimmed batch no longer tiles the mesh, so an on-device ``[:n_valid]``
+    slice would allgather and hand back every leaf fully replicated — a
+    fleet-sized buffer on EVERY chip, exactly what this runtime exists to
+    avoid.  The post-run state is a reporting/checkpoint artifact anyway
+    (telemetry folds and DataWriter decode fetch to host regardless), and
+    ``checkpoint.load_sharded`` re-places a host tree onto a mesh without
+    full-leaf staging when the fleet runs again.  Unsharded/host states
+    keep the plain slice."""
+    if batch_size(state) == n_valid:
+        return state
+
+    def trim(x):
+        shards = getattr(x, "addressable_shards", None)
+        if shards is None or len(shards) <= 1:
+            return x[:n_valid]
+        blocks = {}
+        for sh in shards:  # dedup replicated copies by batch span
+            start = sh.index[0].start or 0 if sh.index else 0
+            if start not in blocks and start < n_valid:
+                blocks[start] = np.asarray(sh.data)
+        return np.concatenate(
+            [blocks[s] for s in sorted(blocks)], axis=0)[:n_valid]
+
+    return jax.tree.map(trim, state)
 
 
 def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
-                        engine=None):
-    """jit-compiled scan of ``num_steps`` events (serial engine) or windows
-    (``engine=sim.parallel_sim``), batch dim sharded over the mesh.
-    Input/output shardings are pinned so the compiled program is pure SPMD
-    with no resharding — both engines are collective-free over dp."""
+                        engine=None, wrap: str = "shard_map"):
+    """jit-compiled sharded chunk runner: ``st -> (st, halted_count)``.
+
+    ``halted_count`` is an in-graph int32 scalar — ``sum(state.halted)``
+    reduced across the mesh — so the host's per-chunk halt poll transfers
+    ONE word instead of the full ``[B]`` bool plane.
+
+    ``wrap="shard_map"`` (default): the engine's chunk scan
+    (``engine.make_scan_fn``) is staged under ``shard_map``, so every shard
+    compiles to its own independent while loop over its local batch slice —
+    per-shard dispatch, and the partitioner can never insert a reshard into
+    the hot loop.  ``wrap="jit"``: the GSPMD-partitioned form of the same
+    program (shardings pinned via ``with_sharding_constraint``), kept for
+    A/B comparison.  Both are bit-identical to the unsharded engines
+    (tests/test_multichip.py).  Input buffers are donated: chunk k+1 reuses
+    chunk k's memory in place.
+
+    The runner is memoized like the engines' ``_compiled_run``: params
+    differing only in horizon/drop rate (which ride in SimState) share one
+    executable; delay/duration-table variants re-trace, since the tables
+    are baked into the scan closure here."""
     eng = engine if engine is not None else sim_ops
-    run = eng.make_run_fn(p, num_steps, batched=True)  # jitted vmapped scan
+    if p.mp_authors and wrap != "shard_map":
+        # The quorum psum needs the 'mp' axis BOUND; plain GSPMD jit has
+        # no named-axis context, so the trace would die with an opaque
+        # "unbound axis name" deep in core/store.py.
+        raise ValueError(
+            "SimParams.mp_authors requires wrap='shard_map' (the 'mp' "
+            "mesh axis must be bound for the quorum psum)")
+    if p.mp_authors and mesh.shape.get("mp", 1) > 1:
+        # The batch dim shards over BOTH axes here, so mp peers hold
+        # DIFFERENT instances: the quorum psum would sum weight tables
+        # across unrelated instances and silently livelock the fleet.
+        # mp_authors > 1-wide meshes need author-sharded state (future
+        # work, see SimParams.mp_authors) — fail loud instead.
+        raise ValueError(
+            "SimParams.mp_authors with n_mp > 1 is unsupported in the dp "
+            "fleet runtime (instances would psum quorum weights across "
+            "each other); use n_mp == 1, or the standalone "
+            "sharded_count_votes/sharded_quorum_reached helpers for "
+            "author-sharded quorums")
+    # Normalize the pure-runtime fields (they live in SimState, not the
+    # graph) so horizon/drop sweeps share one cache entry; delay/delta/
+    # gamma stay in the key — they parameterize the baked tables.
+    key_p = dataclasses.replace(xops.resolve_params(p), max_clock=0,
+                                drop_prob=0.0)
+    return _cached_sharded_run_fn(key_p, mesh, num_steps, eng, wrap)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
+                           eng, wrap: str):
+    axes = tuple(mesh.axis_names)
+    if wrap == "shard_map":
+        inner = eng.make_scan_fn(p, num_steps, batched=True)
+
+        def local(st):
+            st = inner(st)
+            cnt = jax.lax.psum(jnp.sum(st.halted.astype(I32)), axes)
+            return st, cnt
+
+        f = shard_map(local, mesh=mesh, in_specs=(P(axes),),
+                      out_specs=(P(axes), P()), check_rep=False)
+        return jax.jit(f, donate_argnums=(0,))
+    if wrap != "jit":
+        raise ValueError(
+            f"unknown wrap mode {wrap!r}; want 'shard_map' or 'jit'")
+    run = eng.make_run_fn(p, num_steps, batched=True)
     sh = mesh_ops.batch_sharding(mesh)
 
     def sharded(st):
         st = jax.lax.with_sharding_constraint(st, sh)
-        return run(st)
+        st = run(st)
+        return st, jnp.sum(st.halted.astype(I32))
 
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
-                chunk: int = 256, engine=None):
-    """Host loop over sharded chunks until all instances halt."""
-    import numpy as np
+def _poll_halt_count(cnt) -> int:
+    """Blocking host fetch of a chunk's halt scalar — ONE int32, never a
+    ``[B]`` plane.  The single host-sync point of the fleet loop, split out
+    so tests can monkeypatch it and assert exactly that
+    (tests/test_multichip.py::test_poll_path_fetches_scalars_only)."""
+    return int(jax.device_get(cnt))
 
-    run = make_sharded_run_fn(p, mesh, chunk, engine=engine)
+
+def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
+                chunk: int = 256, engine=None, pipeline: bool = True,
+                wrap: str = "shard_map", pad: bool = True):
+    """Pipelined host loop over sharded chunks until the whole fleet halts
+    or ``num_steps`` is reached; returns the (unpadded) final state.
+
+    Double-buffered dispatch: chunk *k+1* is enqueued BEFORE chunk *k*'s
+    halt scalar is polled, so the host's one blocking sync per chunk
+    (:func:`_poll_halt_count`, on the LAGGED future only) overlaps device
+    compute and the dispatch queues never drain between chunks.  The one
+    extra chunk this can run after global halt is a no-op by construction
+    (every engine write is gated on ``live = ~halted``), so trajectories
+    are bit-identical to the non-pipelined loop — and to the unsharded
+    engines.  Donation (make_sharded_run_fn) threads the state in place
+    between chunks.  ``pad=True`` pads a B not divisible by the mesh's
+    device count with pre-halted instances and strips them on return —
+    note that stripping lands a padded fleet's final state on host,
+    shard by shard (see :func:`unpad`); an evenly-dividing B returns the
+    sharded device state as-is."""
+    eng = engine if engine is not None else sim_ops
+    n_valid = batch_size(state)
+    if pad:
+        state, n_valid = pad_to_multiple(p, state, mesh.size, engine=eng)
+    b_total = batch_size(state)
+    if b_total % mesh.size:
+        raise ValueError(
+            f"batch {b_total} not divisible over the mesh's {mesh.size} "
+            "devices; pass pad=True (default) or pre-pad with "
+            "parallel.sharded.pad_to_multiple")
     state = mesh_ops.shard_batch(mesh, sim_ops.dedupe_buffers(state))
-    done_steps = 0
-    while done_steps < num_steps:
-        state = run(state)
-        done_steps += chunk
-        if bool(np.all(jax.device_get(state.halted))):
+    if num_steps <= 0:  # a zero step budget runs nothing (placement only)
+        return unpad(state, n_valid)
+    run = make_sharded_run_fn(p, mesh, chunk, engine=eng, wrap=wrap)
+    state, cnt = run(state)
+    done = chunk
+    while done < num_steps:
+        if not pipeline:
+            if _poll_halt_count(cnt) == b_total:
+                break
+            state, cnt = run(state)
+            done += chunk
+            continue
+        lagged = cnt
+        state, cnt = run(state)  # dispatch k+1 before polling chunk k
+        done += chunk
+        if _poll_halt_count(lagged) == b_total:
             break
-    return state
+    return unpad(state, n_valid)
 
 
 # ---------------------------------------------------------------------------
-# Author-dim (mp) quorum aggregation via psum.
+# Author-dim (mp) quorum aggregation.  The aggregation math lives in
+# core/config.py (one implementation for single-chip and sharded); these
+# wrappers stage it under shard_map with the author axis split over 'mp' —
+# the same psum path the step's quorum checks arm via SimParams.mp_authors.
 # ---------------------------------------------------------------------------
 
 
 def sharded_count_votes(mesh: Mesh, weights, author_mask):
     """count_votes (configuration.rs:43) with the author axis sharded over
-    'mp': each chip sums its local authors, then a psum over mp rides ICI."""
+    'mp': each chip sums its local authors via ``config.count_votes``, whose
+    psum rides ICI."""
 
     def local(w, m):
-        partial = jnp.sum(jnp.where(m, w, 0), axis=-1, keepdims=True)
-        return jax.lax.psum(partial, axis_name="mp")
+        return jnp.reshape(
+            config.count_votes(w, m, axis_name=config.MP_AXIS), (1,))
 
     f = shard_map(
         local, mesh=mesh,
@@ -84,13 +306,14 @@ def sharded_count_votes(mesh: Mesh, weights, author_mask):
 
 
 def sharded_quorum_reached(mesh: Mesh, weights, author_mask):
-    """Whether the masked authors reach the 2N/3+1 quorum, computed with both
-    the mask sum and the total weight as mp-psums."""
+    """Whether the masked authors reach the 2N/3+1 quorum — the exact
+    predicate of the step's quorum sites (``config.count_votes`` vs
+    ``config.quorum_threshold``), with both reductions mp-psums."""
 
     def local(w, m):
-        got = jax.lax.psum(jnp.sum(jnp.where(m, w, 0), keepdims=True), "mp")
-        total = jax.lax.psum(jnp.sum(w, keepdims=True), "mp")
-        return got >= 2 * total // 3 + 1
+        got = config.count_votes(w, m, axis_name=config.MP_AXIS)
+        thr = config.quorum_threshold(w, axis_name=config.MP_AXIS)
+        return jnp.reshape(got >= thr, (1,))
 
     f = shard_map(local, mesh=mesh, in_specs=(P("mp"), P("mp")), out_specs=P())
     return f(weights, author_mask)[0]

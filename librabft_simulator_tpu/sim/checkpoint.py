@@ -121,6 +121,54 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
     return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(like), leaves)
 
 
+def load_sharded(path: str, p: SimParams, mesh, engine=None, like=None):
+    """Restore a batched checkpoint onto a device mesh; returns
+    ``(state, n_valid)``.
+
+    Placement is shard-by-shard (``jax.make_array_from_callback``): each
+    device is fed only its own batch slice, so no device ever materializes
+    a full-leaf buffer — a fleet checkpoint restores onto a pod without a
+    single-chip-sized staging copy.  When the mesh's device count doesn't
+    divide the checkpoint's batch B, the fleet is padded to the next
+    multiple with pre-halted instances instead of crashing
+    (parallel/sharded.pad_to_multiple: padding is masked out of telemetry
+    and DataWriter by construction); ``n_valid`` is the original B — slice
+    ``[:n_valid]`` after fetching to drop the padding.
+
+    ``engine`` picks the state flavor (sim.simulator default, or
+    sim.parallel_sim for PSimState checkpoints); ``like`` overrides the
+    tree template exactly as in :func:`load`."""
+    from ..parallel import mesh as mesh_ops
+    from ..parallel import sharded as sharded_ops
+    from . import simulator as S
+
+    eng = engine if engine is not None else S
+    if like is None:
+        sample = np.load(path)["clock"]
+        if sample.ndim == 0:
+            raise ValueError(
+                "load_sharded needs a batched checkpoint (this one holds a "
+                "single instance); use load() for single-instance restores")
+        # Abstract template only: load() reads shapes/dtypes/structure
+        # from ``like``, so eval_shape avoids actually initialising (and
+        # device-allocating) a fleet-sized state just to describe one.
+        like = jax.eval_shape(
+            lambda: eng.init_batch(p, np.zeros(sample.shape[0], np.uint32)))
+    # load() returns an all-numpy tree, so pad_to_multiple pads ON HOST
+    # (a device concat would stage full leaves on the default device,
+    # exactly what the shard-by-shard placement below exists to avoid).
+    host = load(path, p, like=like)
+    host, n_valid = sharded_ops.pad_to_multiple(p, host, mesh.size,
+                                                engine=eng)
+    sh = mesh_ops.batch_sharding(mesh)
+
+    def put(x):
+        return jax.make_array_from_callback(
+            x.shape, sh, lambda idx, x=x: x[idx])
+
+    return jax.tree.map(put, host), n_valid
+
+
 def save_orbax(path: str, state: SimState) -> None:
     import orbax.checkpoint as ocp
 

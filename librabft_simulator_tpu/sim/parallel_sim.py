@@ -734,8 +734,8 @@ def _equivocate(p: SimParams, pay):
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
+def _scan_run(p_structural: SimParams, num_steps: int, batched: bool):
+    """The raw (untransformed) window-chunk scan (see simulator._scan_run)."""
     packed = bool(p_structural.packed)
 
     def run(delay_table, dur_table, d_min, st):
@@ -752,7 +752,29 @@ def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
 
     if batched:
         run = jax.vmap(run, in_axes=(None, None, None, 0))
-    return jax.jit(run, donate_argnums=(3,))
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
+    return jax.jit(_scan_run(p_structural, num_steps, batched),
+                   donate_argnums=(3,))
+
+
+def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True,
+                 d_min: int | None = None):
+    """Uncompiled counterpart of :func:`make_run_fn` (same contract as
+    simulator.make_scan_fn): the window-chunk scan with tables and lookahead
+    bound but no ``jax.jit``, for the dp-fleet ``shard_map`` wrapping in
+    ``parallel/sharded.py``."""
+    dmin = d_min_of(p) if d_min is None else d_min
+    assert 1 <= dmin <= d_min_of(p), (dmin, d_min_of(p))
+    p = xops.resolve_params(p)
+    run = _scan_run(p.structural(), num_steps, batched)
+    delay_table = jnp.asarray(p.delay_table())
+    dur_table = jnp.asarray(p.duration_table())
+    dmin_arr = jnp.asarray(dmin, I32)
+    return lambda st: run(delay_table, dur_table, dmin_arr, st)
 
 
 def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
@@ -781,8 +803,15 @@ def init_batch(p: SimParams, seeds) -> PSimState:
     return jax.vmap(lambda s: init_state(p, s))(seeds)
 
 
-def run_to_completion(p: SimParams, st: PSimState, chunk: int = 256,
-                      max_chunks: int = 400, batched: bool = False):
+# Default host-loop budget (windows per dispatch x dispatch cap); see
+# simulator.RUN_CHUNK — the dp-fleet sweep path reads these by name.
+RUN_CHUNK = 256
+RUN_MAX_CHUNKS = 400
+
+
+def run_to_completion(p: SimParams, st: PSimState, chunk: int = RUN_CHUNK,
+                      max_chunks: int = RUN_MAX_CHUNKS,
+                      batched: bool = False):
     from .simulator import dedupe_buffers
 
     run = make_run_fn(p, chunk, batched=batched)

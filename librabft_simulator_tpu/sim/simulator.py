@@ -545,8 +545,9 @@ def step_fn_partial(p: SimParams):
     return f
 
 
-@functools.lru_cache(maxsize=None)
-def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
+def _scan_run(p_structural: SimParams, num_steps: int, batched: bool):
+    """The raw (untransformed) chunk scan: ``num_steps`` events per
+    instance, pack/unpack at the boundary when the packed layout is on."""
     packed = bool(p_structural.packed)
 
     def run(delay_table, dur_table, st):
@@ -563,7 +564,28 @@ def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
 
     if batched:
         run = jax.vmap(run, in_axes=(None, None, 0))
-    return jax.jit(run, donate_argnums=(2,))
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
+    return jax.jit(_scan_run(p_structural, num_steps, batched),
+                   donate_argnums=(2,))
+
+
+def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True):
+    """Uncompiled counterpart of :func:`make_run_fn`: the same chunk scan
+    with tables bound but no ``jax.jit``, for callers that stage it under
+    their own transform — the dp-fleet ``shard_map`` wrapping in
+    ``parallel/sharded.py`` needs the untransformed scan so each shard
+    compiles to its own independent while loop.  Resolves the 'auto'
+    lowering fields exactly as make_run_fn does, so both entry points
+    trace the same graph."""
+    p = xops.resolve_params(p)
+    run = _scan_run(p.structural(), num_steps, batched)
+    delay_table = jnp.asarray(p.delay_table())
+    dur_table = jnp.asarray(p.duration_table())
+    return lambda st: run(delay_table, dur_table, st)
 
 
 def make_run_fn(p: SimParams, num_steps: int, batched: bool = True):
@@ -586,8 +608,16 @@ def dedupe_buffers(st):
     return jax.tree.map(lambda x: jnp.array(x, copy=True), st)
 
 
-def run_to_completion(p: SimParams, st: SimState, chunk: int = 256,
-                      max_chunks: int = 400, batched: bool = False):
+# Default host-loop budget: events per dispatch x dispatch cap.  Shared by
+# name with the dp-fleet sweep path (analysis/sweeps.py), which must run
+# under the identical step cap for its rows to be comparable.
+RUN_CHUNK = 256
+RUN_MAX_CHUNKS = 400
+
+
+def run_to_completion(p: SimParams, st: SimState, chunk: int = RUN_CHUNK,
+                      max_chunks: int = RUN_MAX_CHUNKS,
+                      batched: bool = False):
     """Host loop: run until every instance passes max_clock (for tests)."""
     run = make_run_fn(p, chunk, batched=batched)
     st = dedupe_buffers(st)

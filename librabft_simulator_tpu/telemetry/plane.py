@@ -215,6 +215,33 @@ def np_registry(p) -> dict:
     return dict(registry(p.structural())[0])
 
 
+def fold_planes(p, planes_np: np.ndarray, into=None) -> np.ndarray:
+    """Reduce a ``[..., M]`` block of per-instance planes to one ``[M]``
+    int64 partial — counters/histograms sum, high-water marks max —
+    optionally folding into an existing partial.
+
+    This is the associative shard-merge kernel of the fleet runtime: each
+    dp shard's plane block folds independently on the host (telemetry/
+    report.py walks ``addressable_shards``), so the full ``[B, M]`` fleet
+    plane never has to land in one buffer.  All-zero (pre-halted padding)
+    rows are absorbing for both aggregations, which is what makes padded
+    fleets report identically to unpadded ones."""
+    w = np_width(p)
+    out = np.zeros((w,), np.int64) if into is None else into
+    flat = np.asarray(planes_np, np.int64).reshape(-1, w) \
+        if w else np.zeros((0, 0), np.int64)
+    if flat.shape[0] == 0:
+        return out
+    for name, (off, size, agg) in np_registry(p).items():
+        blk = flat[:, off:off + size]
+        if agg == MAX:
+            out[off:off + size] = np.maximum(out[off:off + size],
+                                             blk.max(axis=0))
+        else:
+            out[off:off + size] += blk.sum(axis=0)
+    return out
+
+
 def np_width(p) -> int:
     return int(registry(p.structural())[1])
 

@@ -33,10 +33,10 @@ from . import plane
 
 
 def _metrics_np(st, instance: Optional[int] = None) -> np.ndarray:
-    m = np.asarray(jax.device_get(st.metrics))
+    m = st.metrics
     if instance is not None:
-        m = m[instance]
-    return m
+        m = m[instance]  # slice BEFORE fetching: one row, not the fleet
+    return np.asarray(jax.device_get(m))
 
 
 def _require_one_instance(arr: np.ndarray, batched_ndim: int, what: str):
@@ -54,17 +54,104 @@ def metrics_dict(p, st, instance: Optional[int] = None) -> dict:
     return plane.decode(p, m)
 
 
+def _batch_span(index) -> tuple:
+    """(start, stop) of a shard's slice of the leading (instance) dim."""
+    s = index[0] if index else slice(None)
+    return (s.start or 0, s.stop)
+
+
+def _plane_partial(p, metrics) -> np.ndarray:
+    """Fold a (possibly dp-sharded) metrics plane to one [M] int64 partial.
+
+    Sharded fleet states fold SHARD BY SHARD (each device's local [b, M]
+    block is fetched and reduced independently via plane.fold_planes, then
+    the partials merge) — the full [B, M] plane never lands in one host
+    buffer, which is what lets a 100k-instance fleet report without a
+    fleet-sized staging copy.  Unsharded / host states take the same fold
+    over their single block."""
+    shards = getattr(metrics, "addressable_shards", None)
+    if shards is not None and len(shards) > 1:
+        partial = None
+        seen = set()
+        for sh in shards:
+            span = _batch_span(sh.index)
+            if span in seen:  # replicated copy of an already-folded block
+                continue
+            seen.add(span)
+            partial = plane.fold_planes(p, np.asarray(sh.data), into=partial)
+        if partial is not None:
+            return partial
+    return plane.fold_planes(p, np.asarray(jax.device_get(metrics)))
+
+
 def merged_metrics(p, st) -> dict:
-    """Fold a (possibly batched) plane across all leading dims: counters and
-    histogram buckets sum over the fleet, high-water marks max."""
-    m = _metrics_np(st)
-    flat = m.reshape((-1, m.shape[-1])) if m.ndim > 1 else m[None]
+    """Fold a (possibly batched, possibly dp-sharded) plane across all
+    leading dims: counters and histogram buckets sum over the fleet,
+    high-water marks max.  Sharded fleets merge per shard (see
+    :func:`_plane_partial`); pre-halted padding instances hold all-zero
+    planes and so contribute nothing to either aggregation."""
+    vec = _plane_partial(p, st.metrics)
     out = {}
-    for name, (off, size, agg) in plane.np_registry(p).items():
-        vals = flat[:, off:off + size]
-        red = vals.max(axis=0) if agg == plane.MAX else vals.sum(axis=0)
-        out[name] = int(red[0]) if size == 1 else [int(v) for v in red]
+    for name, (off, size, _) in plane.np_registry(p).items():
+        vals = vec[off:off + size]
+        out[name] = int(vals[0]) if size == 1 else [int(v) for v in vals]
     return out
+
+
+def _flight_rows(p, fdat: np.ndarray, mdat: np.ndarray, base: int,
+                 limit: Optional[int] = None) -> dict:
+    """Decode one shard's [b, K, FR_COLS] flight block -> {global instance
+    index: chronological row dicts} using the fr_count slots of the
+    matching metrics block.  ``limit`` stops decoding at that global
+    instance index (instances past it are never touched)."""
+    fr_off, _ = plane.slot(p, "fr_count")
+    out = {}
+    stop = fdat.shape[0] if limit is None else max(min(limit - base,
+                                                       fdat.shape[0]), 0)
+    for i in range(stop):
+        order = plane.ring_order(int(mdat[i, fr_off]), p.flight_cap)
+        out[base + i] = [
+            dict({name: int(fdat[i, j, col])
+                  for col, name in enumerate(plane.FR_NAMES)},
+                 instance=base + i)
+            for j in order]
+    return out
+
+
+def fleet_flight(p, st, max_instances: Optional[int] = None) -> list[dict]:
+    """Every instance's flight-recorder tail, concatenated in global
+    instance order with an ``instance`` tag per row — the fleet view of
+    :func:`decode_flight`.
+
+    dp-sharded fleets decode shard by shard (flight and metrics blocks are
+    fetched per device and matched on their batch span), mirroring the
+    metrics merge: no full-fleet ring buffer on one host.  Pre-halted
+    padding instances have ``fr_count == 0`` rings and contribute no rows.
+    ``max_instances`` truncates to the first k instances (e.g. the valid
+    count of a padded fleet)."""
+    if not p.telemetry:
+        return []
+    if np.ndim(st.clock) == 0:  # no data movement: shape-only check
+        return [dict(r, instance=0) for r in decode_flight(p, st)]
+    rows = {}
+    fl_shards = getattr(st.flight, "addressable_shards", None)
+    if fl_shards is not None and len(fl_shards) > 1:
+        for sh in fl_shards:
+            span = _batch_span(sh.index)
+            if span[0] in rows or sh.data.shape[0] == 0:
+                continue
+            if max_instances is not None and span[0] >= max_instances:
+                continue  # shard is all truncated instances: skip entirely
+            met = next(m for m in st.metrics.addressable_shards
+                       if _batch_span(m.index) == span)
+            rows.update(_flight_rows(p, np.asarray(sh.data),
+                                     np.asarray(met.data), span[0],
+                                     limit=max_instances))
+    else:
+        rows = _flight_rows(p, np.asarray(jax.device_get(st.flight)),
+                            np.asarray(jax.device_get(st.metrics)), 0,
+                            limit=max_instances)
+    return [r for i in sorted(rows) for r in rows[i]]
 
 
 def decode_flight(p, st, instance: Optional[int] = None) -> list[dict]:
@@ -75,9 +162,10 @@ def decode_flight(p, st, instance: Optional[int] = None) -> list[dict]:
     a per-node chronological view."""
     if not p.telemetry:
         return []
-    fl = np.asarray(jax.device_get(st.flight))
+    fl = st.flight
     if instance is not None:
-        fl = fl[instance]
+        fl = fl[instance]  # slice BEFORE fetching: one ring, not the fleet
+    fl = np.asarray(jax.device_get(fl))
     _require_one_instance(fl, 2, "decode_flight")
     count = metrics_dict(p, st, instance)["fr_count"]
     order = plane.ring_order(count, fl.shape[0])

@@ -25,6 +25,7 @@ cd "$(dirname "$0")/.."
 
 CENSUS_BUDGET=${CENSUS_BUDGET:-220}
 TELEMETRY_CENSUS_BUDGET=${TELEMETRY_CENSUS_BUDGET:-230}
+SHARDED_CENSUS_BUDGET=${SHARDED_CENSUS_BUDGET:-238}
 TIER1_MIN_DOTS=${TIER1_MIN_DOTS:-39}
 
 echo "=== collection check ==="
@@ -49,10 +50,21 @@ dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 fails=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd FE | wc -c)
 echo "DOTS_PASSED=${dots} FAILS=${fails} rc=${rc}"
 
-echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on) ==="
+echo "=== 2-shard dp fleet parity (explicit; the 870 s suite may time out before reaching test_multichip) ==="
+# The pipelined fleet runtime's tier-1 referees: 2-shard parity for both
+# engines at an odd batch, padding telemetry/oracle pinning, and the
+# scalar-only halt-poll assertion.  Runs from the persistent compile cache
+# the suite pass above already populated.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_multichip.py -q -m 'not slow' -p no:cacheprovider \
+    -p no:xdist -p no:randomly
+parity_rc=$?
+
+echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${SHARDED_CENSUS_BUDGET} per-shard) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-max "${CENSUS_BUDGET}" \
-    --assert-telemetry-max "${TELEMETRY_CENSUS_BUDGET}"
+    --assert-telemetry-max "${TELEMETRY_CENSUS_BUDGET}" \
+    --assert-sharded-max "${SHARDED_CENSUS_BUDGET}"
 census_rc=$?
 
 tests_ok=0
@@ -67,6 +79,10 @@ elif [ "$rc" -ne 0 ] && [ "$rc" -ne 124 ]; then
     tests_ok=1
 fi
 if [ "$tests_ok" -ne 0 ]; then
+    exit 1
+fi
+if [ "$parity_rc" -ne 0 ]; then
+    echo "FAIL: 2-shard dp fleet parity rc=$parity_rc" >&2
     exit 1
 fi
 if [ "$census_rc" -ne 0 ]; then

@@ -44,6 +44,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+if "--sharded" in sys.argv or any(
+        a.startswith("--assert-sharded-max") for a in sys.argv):
+    # The sharded census needs virtual devices BEFORE backend init (and
+    # --assert-sharded-max implies --sharded, so it must trigger the shim
+    # too — argparse runs far too late to force the device count).
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -119,6 +129,28 @@ def census_step(p: SimParams, batch: int) -> dict:
     return hlo_counts(compiled.as_text())
 
 
+def census_sharded(p: SimParams, batch: int, dp: int) -> dict:
+    """Per-shard census of the dp-fleet runtime (parallel/sharded.py).
+
+    Lowers + compiles the shard_map-wrapped one-chunk runner (scan length 1
+    == one step per instance, plus the in-graph halted_count reduction) on
+    a dp-shard CPU mesh and counts HLO ops.  Under shard_map the optimized
+    module IS the per-shard program, so ``top_fusions`` here is the kernel
+    count each dispatch engine pays per step — the dp scaling premise
+    (collective-free shards) holds exactly when this stays at the
+    single-chip census plus the O(1) halt-reduction overhead."""
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+    from librabft_simulator_tpu.parallel import sharded
+
+    mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1, devices=jax.devices()[:dp])
+    st = S.init_batch(p, np.arange(batch, dtype=np.uint32))
+    st, _ = sharded.pad_to_multiple(p, st, mesh.size)
+    st = mesh_ops.shard_batch(mesh, st)
+    run = sharded.make_sharded_run_fn(p, mesh, 1)
+    compiled = run.lower(st).compile()
+    return hlo_counts(compiled.as_text())
+
+
 MODES = {
     # The pre-PR serial-step graph, exactly: per-leaf node state,
     # .at[] queue scatters, handlers computed unconditionally.
@@ -153,9 +185,20 @@ def main() -> int:
                     help="exit nonzero if the tpu_shape_telemetry fusion "
                          "count exceeds this budget (CI regression gate; "
                          "recorded in KERNEL_CENSUS_r07.json)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="also census the per-shard dp-fleet program "
+                         "(shard_map runner on a 2-shard virtual CPU mesh)")
+    ap.add_argument("--sharded-dp", type=int, default=2,
+                    help="dp shard count for --sharded (default 2)")
+    ap.add_argument("--assert-sharded-max", type=int, default=None,
+                    help="exit nonzero if the per-shard tpu_shape fusion "
+                         "count exceeds this budget (CI gate; implies "
+                         "--sharded)")
     ap.add_argument("--out", default=None,
                     help="write the full census JSON here")
     args = ap.parse_args()
+    if args.assert_sharded_max is not None:
+        args.sharded = True
 
     base = SimParams(n_nodes=args.n, delay_kind="uniform", max_clock=2**30,
                      queue_cap=max(32, 4 * args.n), unroll=args.unroll)
@@ -178,6 +221,17 @@ def main() -> int:
               f"total_fusions={c['total_fusions']:5d} "
               f"whiles={c['whiles']} scatters={c['scatters']}", flush=True)
 
+    if args.sharded:
+        p_sh = dataclasses.replace(base, **MODES["tpu_shape"])
+        c = census_sharded(p_sh, args.batch, args.sharded_dp)
+        out["modes"]["sharded_tpu_shape"] = c
+        out["sharded_dp"] = args.sharded_dp
+        print(f"{'sharded_tpu_shape':18s} top_fusions={c['top_fusions']:4d} "
+              f"top_dispatch={c['top_dispatch']:4d} "
+              f"total_fusions={c['total_fusions']:5d} "
+              f"whiles={c['whiles']} scatters={c['scatters']} "
+              f"(per shard, dp={args.sharded_dp})", flush=True)
+
     before = out["modes"]["baseline_pre_pr"]["top_fusions"]
     after = out["modes"]["tpu_shape"]["top_fusions"]
     pct = 100.0 * (before - after) / max(before, 1)
@@ -198,6 +252,13 @@ def main() -> int:
         print(f"FAIL: tpu_shape_telemetry top-level fusion count {tel} "
               f"exceeds budget {args.assert_telemetry_max}", file=sys.stderr)
         return 1
+    if args.assert_sharded_max is not None:
+        sh = out["modes"]["sharded_tpu_shape"]["top_fusions"]
+        if sh > args.assert_sharded_max:
+            print(f"FAIL: sharded_tpu_shape per-shard fusion count {sh} "
+                  f"exceeds budget {args.assert_sharded_max}",
+                  file=sys.stderr)
+            return 1
     return 0
 
 

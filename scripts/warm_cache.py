@@ -6,8 +6,9 @@ have accumulated many large compiles.  In a fresh process per shape the
 writes are reliable — so this script compiles each heavy (engine, shape)
 pair in its own subprocess, after which the suite runs from cache.
 
-Usage:  python scripts/warm_cache.py            # suite shapes
+Usage:  python scripts/warm_cache.py            # suite shapes (incl. sharded)
         python scripts/warm_cache.py --bench    # bench + 5-config sweep shapes
+        python scripts/warm_cache.py --fleet    # BENCH_FLEET dp-ladder rungs
         python scripts/warm_cache.py --list     # show shapes
 
 ``--bench`` drives bench.py itself (one child per config, BENCH_REPS=1) so
@@ -45,6 +46,28 @@ SHAPES = [
       "commit_log": 16}, 16, 64),  # test_multichip sharded-parallel shape
 ]
 
+# The tier-1 micro fleet shapes, shared with tests/test_multichip.py via
+# the pure-data module tests/fleet_shapes.py so the warmed executables and
+# the suite's compiled shapes can never drift apart.
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "tests"))
+from fleet_shapes import (  # noqa: E402
+    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW)
+
+# Unsharded reference runs of the tier-1 2-shard parity pair.
+SHAPES += [
+    ("serial", FLEET_SER_KW, FLEET_B, FLEET_CHUNK),
+    ("parallel", FLEET_LANE_KW, FLEET_B, FLEET_CHUNK),
+]
+
+# (engine, SimParams kwargs, batch, chunk, dp): the sharded twins —
+# run_sharded pads batch to the mesh size, so warming with the same raw
+# batch reproduces the compiled shard shapes.
+SHARDED_SHAPES = [
+    ("serial", FLEET_SER_KW, FLEET_B, FLEET_CHUNK, 2),
+    ("parallel", FLEET_LANE_KW, FLEET_B, FLEET_CHUNK, 2),
+]
+
 CHILD = r"""
 import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -75,6 +98,47 @@ else:
 jax.block_until_ready(run(st))
 print("warmed", engine_name, kw, batch)
 """
+
+
+SHARDED_CHILD = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import sys, json
+sys.path.insert(0, %(root)r)
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.parallel import mesh as mesh_ops, sharded
+from librabft_simulator_tpu.sim import parallel_sim, simulator
+
+engine_name, kw, batch, chunk, dp = json.loads(sys.argv[1])
+engine = parallel_sim if engine_name == "parallel" else simulator
+p = SimParams(max_clock=500, **kw)
+mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1, devices=jax.devices()[:dp])
+st = engine.init_batch(p, sharded.fleet_seeds(0, batch))
+st = sharded.run_sharded(p, mesh, st, num_steps=chunk, chunk=chunk,
+                         engine=engine)
+jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+print("warmed sharded", engine_name, kw, batch, "dp", dp)
+"""
+
+
+def warm_fleet(root: str) -> None:
+    """Compile every BENCH_FLEET ladder rung into bench.py's persistent
+    cache (one subprocess per rung is the ladder's own protocol, so shapes
+    — dp, per-shard batch, chunk — match the real run exactly and
+    ``BENCH_FLEET=1 python bench.py`` afterwards pays ~0 s compile)."""
+    env = dict(os.environ, BENCH_FLEET="1", BENCH_FLEET_REPS="1",
+               BENCH_FLEET_OUT="/tmp/warm_fleet.json")
+    r = subprocess.run([sys.executable, "bench.py"], cwd=root, env=env,
+                       stdout=subprocess.DEVNULL)
+    print(f"[warm_cache] fleet ladder: rc={r.returncode}", flush=True)
 
 
 def warm_bench(root: str) -> None:
@@ -108,9 +172,14 @@ def main():
     if "--list" in sys.argv:
         for e, kw, b, c in SHAPES:
             print(e, kw, b, c)
+        for e, kw, b, c, dp in SHARDED_SHAPES:
+            print(e, kw, b, c, f"dp={dp}")
         return
     if "--bench" in sys.argv:
         warm_bench(root)
+        return
+    if "--fleet" in sys.argv:
+        warm_fleet(root)
         return
     import json
 
@@ -121,6 +190,13 @@ def main():
             cwd=root)
         print(f"[warm_cache] {e} {kw} b={b} chunk={c}: rc={r.returncode}",
               flush=True)
+    for e, kw, b, c, dp in SHARDED_SHAPES:
+        r = subprocess.run(
+            [sys.executable, "-c", SHARDED_CHILD % {"root": root},
+             json.dumps([e, kw, b, c, dp])],
+            cwd=root)
+        print(f"[warm_cache] sharded {e} {kw} b={b} chunk={c} dp={dp}: "
+              f"rc={r.returncode}", flush=True)
 
 
 if __name__ == "__main__":

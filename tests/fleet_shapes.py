@@ -1,0 +1,17 @@
+"""The tier-1 micro fleet shapes — single source of truth.
+
+tests/test_multichip.py builds its P_SER/P_LANE params from these dicts and
+scripts/warm_cache.py warms executables for exactly them, so the warmed
+compile-cache keys and the suite's compiled shapes can never drift apart
+(only max_clock differs between the two consumers, and max_clock is
+runtime data, outside the jit key).
+
+Pure data: no imports, safe to load from any process.
+"""
+
+FLEET_SER_KW = {"n_nodes": 3, "window": 8, "chain_k": 2, "commit_log": 8,
+                "queue_cap": 16, "telemetry": True, "flight_cap": 16,
+                "trace_cap": 32}
+FLEET_LANE_KW = dict(FLEET_SER_KW, n_nodes=4, delay_kind="uniform")
+FLEET_B = 5        # deliberately not divisible by the 2-shard mesh
+FLEET_CHUNK = 32

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.sim import checkpoint as C
@@ -70,6 +71,46 @@ def test_batched_checkpoint(tmp_path):
     st2 = C.load(f, p, like=S.init_batch(p, np.zeros(4, np.uint32)))
     for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st2)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_restore_pads_and_masks(tmp_path):
+    """Restoring a checkpoint saved at a batch the mesh's device count
+    doesn't divide pads with pre-halted instances instead of crashing:
+    protocol leaves restore exactly onto the mesh (placed shard by shard),
+    the padding is born halted with zero observables, and a divisible batch
+    restores without padding."""
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+
+    p = SimParams(n_nodes=3, max_clock=300)
+    st = S.init_batch(p, np.arange(5, dtype=np.uint32))
+    f = str(tmp_path / "fleet.npz")
+    C.save(f, st)
+
+    mesh = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    st2, n_valid = C.load_sharded(f, p, mesh)  # 5 % 2 != 0 -> pad to 6
+    assert n_valid == 5
+    assert int(st2.clock.shape[0]) == 6
+    assert len(st2.clock.sharding.device_set) == 2
+    for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[:5])
+    halted = np.asarray(st2.halted)
+    assert bool(halted[5]) and not halted[:5].any()
+    assert int(np.asarray(st2.n_events)[5]) == 0
+
+    # Divisible batch: no padding, same placement path.
+    st4 = S.init_batch(p, np.arange(4, dtype=np.uint32))
+    f4 = str(tmp_path / "fleet4.npz")
+    C.save(f4, st4)
+    st5, n_valid4 = C.load_sharded(f4, p, mesh)
+    assert n_valid4 == 4 and int(st5.clock.shape[0]) == 4
+    for a, b in zip(jax.tree.leaves(st4), jax.tree.leaves(st5)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # A single-instance checkpoint is not a fleet: clear error, not a crash.
+    f1 = str(tmp_path / "one.npz")
+    C.save(f1, S.init_state(p, 0))
+    with pytest.raises(ValueError, match="batched"):
+        C.load_sharded(f1, p, mesh)
 
 
 def test_load_checkpoint_missing_new_fields(tmp_path):

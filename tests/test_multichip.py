@@ -1,14 +1,54 @@
-"""Multi-chip: sharded run equals unsharded run on the 8-device CPU mesh."""
+"""Multi-chip fleet runtime: sharded == unsharded on the virtual CPU mesh.
+
+Tier-1 (non-slow) coverage runs a REAL 2-shard dp fleet end to end on
+micro-capacity params — small window/queue/horizon keep the two extra XLA
+compiles (reference chunk scan + its shard_map wrapping) inside the tier-1
+budget — and pins, from one pair of runs each for the serial and lane
+engines:
+
+* leaf-bit-identical trajectories vs the unsharded engine at a batch NOT
+  divisible by the shard count (the pre-halted padding path);
+* telemetry-plane merge and flight-recorder equality (the per-shard fold
+  in telemetry/report.py);
+* DataWriter round-trace equality per instance;
+* padding contributes ZERO to every observable, pinned against the
+  pure-Python oracle;
+* the pipelined host loop's poll path transfers scalars only (never the
+  [B] halt plane);
+* the mp quorum path armed by SimParams.mp_authors is live in the real
+  step (degenerate n_mp=1 identity).
+
+The 8-shard full-horizon runs stay @slow (multi-minute compile+run on the
+8 *virtual* device mesh; environment-bound, not logic-bound).
+"""
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from fleet_shapes import (
+    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW)
 from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.parallel import mesh as mesh_ops
 from librabft_simulator_tpu.parallel import sharded
+from librabft_simulator_tpu.sim import parallel_sim as PE
 from librabft_simulator_tpu.sim import simulator as S
+from librabft_simulator_tpu.telemetry import report as treport
+
+
+def assert_leaves_equal(a, b, n_valid=None):
+    """Bit-equality of every leaf (optionally only the first n_valid
+    instances of ``b``, for padded fleets)."""
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        y = np.asarray(y)
+        if n_valid is not None:
+            y = y[:n_valid]
+        np.testing.assert_array_equal(np.asarray(x), y)
 
 
 @pytest.fixture(scope="module")
@@ -17,34 +57,227 @@ def mesh():
     return mesh_ops.make_mesh(n_dp=4, n_mp=2)
 
 
-@pytest.mark.slow  # 102,400-step sharded run on 8 *virtual* CPU devices:
-# multi-minute compile+run, the single biggest sink in the 870 s tier-1
-# budget; the placement/psum tests below keep multichip wiring covered.
-def test_sharded_equals_unsharded(mesh):
-    p = SimParams(n_nodes=3, max_clock=300)
-    seeds = np.arange(16, dtype=np.uint32)
-    ref = S.run_to_completion(p, S.init_batch(p, seeds), batched=True)
-    st = sharded.run_sharded(p, mesh, S.init_batch(p, seeds), num_steps=512 * 200)
-    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(st)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+@pytest.fixture(scope="module")
+def mesh2():
+    """A 2-shard pure-dp mesh on the first two virtual devices."""
+    return mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
 
 
-@pytest.mark.slow  # 25,600-step sharded lane-engine run on the virtual
-# mesh (see above); environment-bound, not logic-bound.
-def test_sharded_parallel_engine_equals_unsharded(mesh):
-    """The lane-compacted throughput engine is also collective-free SPMD
-    over dp: sharded == unsharded, bit-exact."""
-    from librabft_simulator_tpu.sim import parallel_sim as P
+# Micro-capacity fleet shapes: small enough that the tier-1 compile cost
+# of (reference scan + shard_map wrapping) stays modest, big enough for a
+# non-trivial run (hundreds of events, commits, round switches).  B=5 is
+# deliberately NOT divisible by the 2-shard mesh: every fixture run
+# exercises the pre-halted padding path.  The structural kwargs come from
+# tests/fleet_shapes.py — the single source of truth shared with
+# scripts/warm_cache.py — so the shapes the cache warmer compiles are
+# exactly the shapes these tests run (max_clock is runtime data, outside
+# the jit key).
+P_SER = SimParams(max_clock=120, **FLEET_SER_KW)
+P_LANE = SimParams(max_clock=150, **FLEET_LANE_KW)
+B_ODD = FLEET_B
+CHUNK = FLEET_CHUNK
+SEEDS = sharded.fleet_seeds(0, B_ODD)
 
-    p = SimParams(n_nodes=4, max_clock=400, window=8, chain_k=2,
-                  commit_log=16, delay_kind="uniform")
-    seeds = np.arange(16, dtype=np.uint32)
-    ref = P.run_to_completion(p, P.init_batch(p, seeds), chunk=64,
+
+@pytest.fixture(scope="module")
+def serial_pair(mesh2):
+    ref = S.run_to_completion(P_SER, S.init_batch(P_SER, SEEDS), chunk=CHUNK,
                               batched=True)
-    st = sharded.run_sharded(p, mesh, P.init_batch(p, seeds),
-                             num_steps=64 * 400, chunk=64, engine=P)
-    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(st)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    st = sharded.run_sharded(P_SER, mesh2, S.init_batch(P_SER, SEEDS),
+                             num_steps=CHUNK * 200, chunk=CHUNK)
+    return ref, st
+
+
+@pytest.fixture(scope="module")
+def lane_pair(mesh2):
+    ref = PE.run_to_completion(P_LANE, PE.init_batch(P_LANE, SEEDS),
+                               chunk=CHUNK, batched=True)
+    st = sharded.run_sharded(P_LANE, mesh2, PE.init_batch(P_LANE, SEEDS),
+                             num_steps=CHUNK * 200, chunk=CHUNK, engine=PE)
+    return ref, st
+
+
+def test_make_mesh_too_few_devices_raises():
+    with pytest.raises(ValueError, match="devices"):
+        mesh_ops.make_mesh(n_dp=len(jax.devices()) + 1, n_mp=1)
+    with pytest.raises(ValueError, match="n_mp"):
+        mesh_ops.make_mesh(n_dp=1, n_mp=0)
+
+
+def test_two_shard_serial_parity_odd_batch(serial_pair):
+    """Serial engine, 2 dp shards, B=5 (padded to 6): every leaf —
+    including the telemetry plane and flight ring — is bit-identical to
+    the unsharded fleet, and a non-trivial amount of work ran."""
+    ref, st = serial_pair
+    assert_leaves_equal(ref, st)
+    assert int(np.sum(np.asarray(st.n_events))) > 100
+    assert min(int(c) for c in np.asarray(st.ctx.commit_count).ravel()) > 0
+
+
+def test_two_shard_lane_engine_parity_odd_batch(lane_pair):
+    """The lane-compacted throughput engine is collective-free SPMD over dp
+    too: 2-shard run bit-identical at the padded odd batch."""
+    ref, st = lane_pair
+    assert_leaves_equal(ref, st)
+    assert int(np.sum(np.asarray(st.n_events))) > 100
+
+
+def test_two_shard_telemetry_merge_and_datawriter(serial_pair):
+    """The per-shard telemetry fold and the DataWriter decode of the
+    sharded fleet equal the unsharded ones exactly."""
+    from librabft_simulator_tpu.analysis import data_writer as dw
+
+    ref, st = serial_pair
+    assert treport.merged_metrics(P_SER, st) == treport.merged_metrics(
+        P_SER, ref)
+    full = treport.fleet_flight(P_SER, st)
+    assert full == treport.fleet_flight(P_SER, ref)
+    assert treport.fleet_flight(P_SER, st, max_instances=2) == [
+        r for r in full if r["instance"] < 2]
+    for i in range(B_ODD):
+        np.testing.assert_array_equal(
+            dw.round_switch_table(P_SER, st, i),
+            dw.round_switch_table(P_SER, ref, i))
+        assert dw.summary_dict(P_SER, st, i) == dw.summary_dict(P_SER, ref, i)
+
+
+def test_sharded_telemetry_fold_divisible_batch(serial_pair, mesh2):
+    """The per-SHARD fold branches of telemetry/report.py
+    (addressable_shards walk in _plane_partial, metrics-shard span matching
+    and the max_instances skip in fleet_flight) against the host fold on
+    identical data.  The parity fixtures all use the padded odd batch,
+    whose result lands on host (unpad) and takes the single-block fallback
+    — so this re-places a DIVISIBLE slice of the same run onto the mesh,
+    the placement a divisible-B production fleet (sweeps --dp) reports
+    from, with no extra engine compiles."""
+    ref, _ = serial_pair
+    host4 = jax.tree.map(lambda x: np.asarray(x)[:4], ref)
+    sh = mesh_ops.batch_sharding(mesh2)
+    dev4 = jax.tree.map(lambda x: jax.device_put(x, sh), host4)
+    assert len(dev4.metrics.addressable_shards) == 2  # genuinely 2-sharded
+    assert treport.merged_metrics(P_SER, dev4) == treport.merged_metrics(
+        P_SER, host4)
+    full = treport.fleet_flight(P_SER, dev4)
+    assert full == treport.fleet_flight(P_SER, host4)
+    # max_instances=2: the second shard (span [2, 4)) is skipped whole;
+    # =3: the limit cuts mid-shard.
+    for k in (2, 3):
+        assert treport.fleet_flight(P_SER, dev4, max_instances=k) == [
+            r for r in full if r["instance"] < k]
+
+
+def test_padding_contributes_zero_oracle_pinned(serial_pair):
+    """Padded (pre-halted) instances contribute nothing to any observable:
+    the padded 2-shard fleet's merged counters equal the SUM of the
+    pure-Python oracle's per-instance tallies (any padding leakage would
+    overshoot), and its flight rows are exactly the real instances'."""
+    from librabft_simulator_tpu.oracle.sim import OracleSim
+
+    _, st = serial_pair
+    orcs = [OracleSim(P_SER, int(s)).run() for s in SEEDS]
+    md = treport.merged_metrics(P_SER, st)
+    ev = [md["ev_notify"], md["ev_request"], md["ev_response"],
+          md["ev_timer"]]
+    assert ev == [sum(o.tel["ev_kind"][k] for o in orcs) for k in range(4)]
+    assert md["fr_count"] == sum(o.n_events for o in orcs)
+    assert md["drops"] == sum(o.n_msgs_dropped for o in orcs)
+    assert md["overflow"] == sum(o.n_queue_full for o in orcs)
+    assert md["sync_jumps"] == sum(
+        sum(c.sync_jumps for c in o.ctxs) for o in orcs)
+    assert md["queue_hwm"] == max(o.tel["queue_hwm"] for o in orcs) > 0
+    assert md["node_depth_hwm"] == [
+        max(o.tel["node_depth_hwm"][a] for o in orcs)
+        for a in range(P_SER.n_nodes)]
+    assert md["commit_lat_miss"] == sum(o.tel["commit_lat_miss"] for o in orcs)
+    # Flight rows: per real instance, the oracle's event-log tail —
+    # and no rows at all from padding (every instance tag < B).
+    rows = treport.fleet_flight(P_SER, st)
+    assert {r["instance"] for r in rows} <= set(range(B_ODD))
+    for i, orc in enumerate(orcs):
+        mine = [{k: v for k, v in r.items() if k != "instance"}
+                for r in rows if r["instance"] == i]
+        assert len(mine) == min(P_SER.flight_cap, orc.n_events)
+        assert mine == orc.tel["flight"][-len(mine):]
+
+
+def test_poll_path_fetches_scalars_only(mesh2, monkeypatch, serial_pair):
+    """The pipelined host loop's per-chunk halt poll transfers ONE int32 —
+    never the [B] halted plane (the pre-PR run_sharded fetched the full
+    plane every chunk)."""
+    fetched = []
+    real_get = jax.device_get
+
+    def spy(x):
+        fetched.append(np.shape(x))
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", spy)
+    st = sharded.run_sharded(P_SER, mesh2, S.init_batch(P_SER, SEEDS),
+                             num_steps=CHUNK * 200, chunk=CHUNK)
+    assert len(fetched) > 0
+    assert all(s == () for s in fetched), fetched
+    monkeypatch.undo()
+    assert_leaves_equal(serial_pair[0], st)
+
+
+def test_non_pipelined_fallback_matches(mesh2, serial_pair):
+    """pipeline=False (strict chunk-by-chunk polling) and the GSPMD 'jit'
+    wrap both yield the identical trajectory."""
+    ref, _ = serial_pair
+    st = sharded.run_sharded(P_SER, mesh2, S.init_batch(P_SER, SEEDS),
+                             num_steps=CHUNK * 200, chunk=CHUNK,
+                             pipeline=False)
+    assert_leaves_equal(ref, st)
+    st_jit = sharded.run_sharded(P_SER, mesh2, S.init_batch(P_SER, SEEDS),
+                                 num_steps=CHUNK * 200, chunk=CHUNK,
+                                 wrap="jit")
+    assert_leaves_equal(ref, st_jit)
+
+
+def test_pad_round_trip_and_seeds():
+    st = S.init_batch(P_SER, SEEDS)
+    padded, n_valid = sharded.pad_to_multiple(P_SER, st, 4)
+    assert n_valid == B_ODD and sharded.batch_size(padded) == 8
+    assert np.all(np.asarray(padded.halted)[B_ODD:])
+    assert not np.any(np.asarray(padded.halted)[:B_ODD])
+    assert_leaves_equal(st, sharded.unpad(padded, n_valid))
+    # fleet_seeds is layout-independent: per-shard slices == global slice.
+    all16 = sharded.fleet_seeds(7, 16)
+    np.testing.assert_array_equal(all16[4:8], sharded.fleet_seeds(7, 4, 4))
+
+
+def test_mp_authors_quorum_wiring():
+    """SimParams.mp_authors arms the psum path inside the REAL quorum
+    checks (core/store.py via core/config.py): a full step traced under a
+    1-shard mp shard_map is bit-identical to the plain step, and the psum
+    is actually in the traced graph (count_votes outside an 'mp' context
+    raises)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as PS
+    from librabft_simulator_tpu.core import config
+
+    p0 = SimParams(n_nodes=3, max_clock=100, window=8, chain_k=2,
+                   commit_log=8, queue_cap=16)
+    p1 = dataclasses.replace(p0, mp_authors=True)
+    mesh1 = mesh_ops.make_mesh(n_dp=1, n_mp=1, devices=jax.devices()[:1])
+    ref = jax.jit(S.step_fn_partial(p0))(S.init_state(p0, 7))
+    stepped = shard_map(S.step_fn_partial(p1), mesh=mesh1, in_specs=(PS(),),
+                        out_specs=PS(), check_rep=False)
+    got = jax.jit(stepped)(S.init_state(p1, 7))
+    assert_leaves_equal(ref, got)
+    # The armed path really is a collective: no mp axis in scope -> error.
+    with pytest.raises(NameError):
+        jax.jit(lambda w: config.count_votes(
+            w, w > 0, axis_name=config.MP_AXIS))(jnp.ones((4,), jnp.int32))
+    # And the fleet runtime refuses mp_authors on a wide mp mesh (the
+    # batch shards over BOTH axes there, so the quorum psum would mix
+    # unrelated instances' weights — fail loud, not livelock).
+    mesh_1x2 = mesh_ops.make_mesh(n_dp=1, n_mp=2, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="mp_authors"):
+        sharded.make_sharded_run_fn(p1, mesh_1x2, 4)
+    # ... and under the GSPMD wrap even at n_mp == 1 (no bound axis there).
+    with pytest.raises(ValueError, match="shard_map"):
+        sharded.make_sharded_run_fn(p1, mesh1, 4, wrap="jit")
 
 
 def test_shard_placement(mesh):
@@ -60,3 +293,38 @@ def test_mp_quorum_psum(mesh):
     assert bool(sharded.sharded_quorum_reached(mesh, w, mask))
     mask2 = jnp.arange(16) < 10
     assert not bool(sharded.sharded_quorum_reached(mesh, w, mask2))
+
+
+@pytest.mark.slow  # 102,400-step sharded run on 8 *virtual* CPU devices:
+# multi-minute compile+run, the single biggest sink in the 870 s tier-1
+# budget; the micro 2-shard parities above keep the runtime covered in
+# tier-1.
+def test_sharded_equals_unsharded_8dev(mesh):
+    from librabft_simulator_tpu.analysis import data_writer as dw
+
+    p = SimParams(n_nodes=3, max_clock=300, telemetry=True, flight_cap=32,
+                  trace_cap=64)
+    seeds = np.arange(13, dtype=np.uint32)  # NOT divisible by the 8 devices
+    ref = S.run_to_completion(p, S.init_batch(p, seeds), batched=True)
+    st = sharded.run_sharded(p, mesh, S.init_batch(p, seeds),
+                             num_steps=512 * 200)
+    assert_leaves_equal(ref, st)
+    assert treport.merged_metrics(p, st) == treport.merged_metrics(p, ref)
+    for i in range(len(seeds)):
+        np.testing.assert_array_equal(dw.round_switch_table(p, st, i),
+                                      dw.round_switch_table(p, ref, i))
+
+
+@pytest.mark.slow  # 25,600-step sharded lane-engine run on the virtual
+# mesh (see above); environment-bound, not logic-bound.
+def test_sharded_parallel_engine_equals_unsharded_8dev(mesh):
+    """The lane-compacted throughput engine is also collective-free SPMD
+    over dp: sharded == unsharded, bit-exact, with padding."""
+    p = SimParams(n_nodes=4, max_clock=400, window=8, chain_k=2,
+                  commit_log=16, delay_kind="uniform")
+    seeds = np.arange(13, dtype=np.uint32)
+    ref = PE.run_to_completion(p, PE.init_batch(p, seeds), chunk=64,
+                               batched=True)
+    st = sharded.run_sharded(p, mesh, PE.init_batch(p, seeds),
+                             num_steps=64 * 400, chunk=64, engine=PE)
+    assert_leaves_equal(ref, st)
