@@ -485,6 +485,8 @@ def _fleet_child() -> dict:
     from librabft_simulator_tpu.parallel import sharded
     from librabft_simulator_tpu.sim import parallel_sim, simulator
     from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+    from librabft_simulator_tpu.telemetry import stream as tstream
+    from librabft_simulator_tpu.utils.xops import _bool_env
 
     dp = int(os.environ["BENCH_FLEET_CHILD"])
     engine_name = os.environ.get("BENCH_FLEET_ENGINE", "serial")
@@ -493,36 +495,53 @@ def _fleet_child() -> dict:
     chunk = int(os.environ.get("BENCH_FLEET_STEPS", 16))
     reps = int(os.environ.get("BENCH_FLEET_REPS", 2))
     n_nodes = int(os.environ.get("BENCH_NODES", 4))
+    streaming = _bool_env("BENCH_STREAM")
     batch = b_per * dp
     p = SimParams(n_nodes=n_nodes, delay_kind="uniform",
                   queue_cap=max(32, 4 * n_nodes), epoch_handoff=False,
-                  max_clock=2**30)
+                  max_clock=2**30,
+                  watchdog=_bool_env("BENCH_WATCHDOG") or False)
     mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1, devices=jax.devices()[:dp])
     st = engine.init_batch(p, sharded.fleet_seeds(0, batch))
     st = mesh_ops.shard_batch(mesh, dedupe_buffers(st))
     run = sharded.make_sharded_run_fn(p, mesh, chunk, engine=engine)
+    # With streaming on, the per-chunk digest poll is the PRODUCTION loop
+    # shape: one [D] fetch per chunk (what run_sharded pays), recorded on
+    # a TimelineRecorder.  Streaming off keeps the pure pipelined regime
+    # (no per-chunk host sync at all) so the two rows A/B the poll cost.
+    rec = tstream.TimelineRecorder(p, total_instances=batch) \
+        if streaming else None
     t_c = time.perf_counter()
-    st, cnt = run(st)
+    st, dg = run(st)
     jax.block_until_ready(st)
     compile_s = time.perf_counter() - t_c
     e0 = int(np.sum(jax.device_get(st.n_events)))
     r0 = _fleet_rounds(st.store.current_round)
+    if rec is not None:
+        rec.record(np.asarray(jax.device_get(dg)), steps=chunk)
     t0 = time.perf_counter()
-    for _ in range(reps):
-        st, cnt = run(st)  # pipelined regime: no per-chunk host sync at all
+    for i in range(reps):
+        st, dg = run(st)  # pipelined regime: no per-chunk host sync at all
+        if rec is not None:  # ... unless streaming: one [D] poll per chunk
+            rec.record(np.asarray(jax.device_get(dg)),
+                       steps=chunk * (i + 2))
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0
     e1 = int(np.sum(jax.device_get(st.n_events)))
     r1 = _fleet_rounds(st.store.current_round)
-    return {
+    row = {
         "dp": dp, "engine": engine_name, "instances": batch,
         "per_shard_instances": b_per, "n_nodes": n_nodes,
         "steps": chunk * reps,
         "events_per_sec": round((e1 - e0) / dt, 1),
         "rounds_per_sec": round((r1 - r0) / dt, 1),
         "elapsed_s": round(dt, 3), "compile_s": round(compile_s, 1),
-        "halted": int(jax.device_get(cnt)),
+        "halted": int(np.asarray(jax.device_get(dg))[tstream.SLOT["halted"]]),
+        "watchdog": bool(p.watchdog),
     }
+    if rec is not None:
+        row["stream"] = rec.summary()
+    return row
 
 
 def run_fleet_ladder(out_path: str) -> dict:
@@ -581,6 +600,27 @@ def run_fleet_ladder(out_path: str) -> dict:
     }
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
+    if any("stream" in r for r in rows):
+        # BENCH_STREAM=1: the per-rung digest timelines become their own
+        # artifact — the fleet-health stream each rung polled per chunk
+        # (telemetry/stream.py), with the slot registry version pinned.
+        from librabft_simulator_tpu.telemetry import stream as tstream
+
+        tl_path = os.environ.get("BENCH_STREAM_OUT",
+                                 "FLEET_TIMELINE_r09.json")
+        timeline = {
+            "kind": "fleet_timeline",
+            "registry_version": tstream.REGISTRY_VERSION,
+            "digest_slots": [n for n, _ in tstream.DIGEST_SLOTS],
+            "rungs": [{"dp": r["dp"], "engine": r["engine"],
+                       "instances": r["instances"],
+                       "stream": r["stream"]}
+                      for r in rows if "stream" in r],
+        }
+        with open(tl_path, "w") as f:
+            json.dump(timeline, f, indent=1)
+        print(f"bench: wrote fleet timeline artifact {tl_path}",
+              file=sys.stderr)
     head = {
         "metric": "fleet_events_per_sec",
         "value": rows[-1]["events_per_sec"] if rows else 0.0,

@@ -64,11 +64,17 @@ def _fleet_stats(p: SimParams, st, elapsed: float) -> dict:
 
 def run_config(p: SimParams, n_instances: int, seed0: int = 0,
                f: int = 0, byz_kind: str = "equivocate", engine=S,
-               dp: int = 0) -> dict:
+               dp: int = 0, stream=None) -> dict:
     """``dp > 0`` runs the config on a dp-shard device mesh via the
     pipelined fleet runtime (parallel/sharded.py): the instance batch is
     padded to the device count with pre-halted instances (zero effect on
-    every reported stat) and each shard dispatches its own chunk loop."""
+    every reported stat) and each shard dispatches its own chunk loop.
+
+    ``stream`` (a telemetry/stream.TimelineRecorder) receives the
+    per-chunk fleet-health digest on BOTH paths — the sharded runtime's
+    halt poll carries it for free; the single-device loop switches its
+    halt check to the same one-[D]-fetch contract — and the row gains the
+    recorder's timeline summary."""
     seeds = np.arange(seed0, seed0 + n_instances, dtype=np.uint32)
     if f > 0:
         if engine is not S:
@@ -92,16 +98,18 @@ def run_config(p: SimParams, n_instances: int, seed0: int = 0,
         t0 = time.perf_counter()
         st = sharded.run_sharded(
             p, mesh, st, num_steps=chunk * engine.RUN_MAX_CHUNKS,
-            chunk=chunk, engine=engine)
+            chunk=chunk, engine=engine, stream=stream)
         # The pipelined loop returns with the last chunk possibly still in
         # flight; sync before reading the clock or elapsed understates.
         jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
         elapsed = time.perf_counter() - t0
     else:
         t0 = time.perf_counter()
-        st = engine.run_to_completion(p, st, batched=True)
+        st = engine.run_to_completion(p, st, batched=True, stream=stream)
         elapsed = time.perf_counter() - t0
     out = _fleet_stats(p, st, elapsed)
+    if stream is not None:
+        out["stream"] = stream.summary()
     if dp > 0:
         out["dp"] = dp
     if f > 0:
@@ -142,11 +150,18 @@ def baseline_configs(scale: float = 1.0) -> dict:
 
 
 def run_all(scale: float = 1.0, out_path: str | None = None,
-            telemetry: bool = False, dp: int = 0) -> dict:
+            telemetry: bool = False, dp: int = 0,
+            stream_out: str | None = None, watchdog: bool = False) -> dict:
+    """``stream_out`` streams every non-sweep config's per-chunk digest
+    timeline as NDJSON — one file per config, ``{stem}.{config}.ndjson``
+    (watch any of them live with scripts/fleet_watch.py) — and attaches
+    the timeline summary to the config's result row."""
     results = {}
     for name, (p, n, f_mode) in baseline_configs(scale).items():
         if telemetry:
             p = dataclasses.replace(p, telemetry=True)
+        if watchdog:
+            p = dataclasses.replace(p, watchdog=True)
         if f_mode == "sweep":
             # f > 0 batches stay on the single-device serial path (see
             # run_config); the dp mesh applies to the plain fleet configs.
@@ -155,8 +170,21 @@ def run_all(scale: float = 1.0, out_path: str | None = None,
                 for r in B.f_sweep(p, n, f_values=list(range(p.n_nodes // 3 + 1)))
             ]
         else:
-            results[name] = run_config(
-                p, n, engine=P if f_mode == "parallel" else S, dp=dp)
+            stream = None
+            if stream_out:
+                from ..telemetry import stream as tstream
+
+                stem = stream_out[:-7] if stream_out.endswith(".ndjson") \
+                    else stream_out
+                stream = tstream.TimelineRecorder(
+                    p, out=f"{stem}.{name}.ndjson", meta={"config": name})
+            try:
+                results[name] = run_config(
+                    p, n, engine=P if f_mode == "parallel" else S, dp=dp,
+                    stream=stream)
+            finally:
+                if stream is not None:
+                    stream.close()
         print(f"[sweep] {name}: done", file=sys.stderr)
     if out_path:
         with open(out_path, "w") as f:
@@ -181,6 +209,13 @@ def main(argv=None):
                     help="pin the jax backend (the environment's TPU plugin "
                          "ignores JAX_PLATFORMS and hangs ~25 min when its "
                          "tunnel is down — pass cpu for host runs)")
+    ap.add_argument("--stream-out", default=None, metavar="PATH",
+                    help="stream each config's per-chunk fleet-health "
+                         "digest timeline as NDJSON to PATH.<config>.ndjson "
+                         "(live view: python scripts/fleet_watch.py <file>)")
+    ap.add_argument("--watchdog", action="store_true",
+                    help="run with SimParams.watchdog on so the streamed "
+                         "digests carry live consensus-anomaly trip counts")
     args = ap.parse_args(argv)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -191,7 +226,8 @@ def main(argv=None):
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
     results = run_all(args.scale, args.out, telemetry=args.telemetry,
-                      dp=args.dp)
+                      dp=args.dp, stream_out=args.stream_out,
+                      watchdog=args.watchdog)
     print(json.dumps(results, indent=2))
 
 

@@ -161,6 +161,7 @@ class PackedSimState:
     trace_count: Array
     metrics: Array
     flight: Array
+    wd: Array
 
 
 _SIM_COMMON = _common_fields(SimState)

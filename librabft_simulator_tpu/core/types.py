@@ -174,6 +174,19 @@ class SimParams:
     # build (tests/test_telemetry.py + the kernel-census CI gate).
     telemetry: bool = False
     flight_cap: int = 32      # K: flight-recorder ring rows (telemetry on)
+    # In-graph consensus watchdog (telemetry/stream.py): a per-instance
+    # [WD] int32 plane of anomaly detectors — liveness stall (no pacemaker
+    # round advance for ``watchdog_stall_events`` processed events),
+    # queue-pressure saturation, sync-jump anomaly, and the safety
+    # invariants (conflicting commit at the same height across nodes;
+    # round regression inside one node's committed chain, epoch-aware).
+    # Trip counts surface live in the fleet digest that rides the
+    # run_sharded halt poll.  Static and default OFF: disabled, the wd
+    # leaf is zero-width and every update compiles out, so the graph is
+    # bit- and kernel-identical to a watchdog-free build
+    # (tests/test_stream.py + the kernel-census CI gate).
+    watchdog: bool = False
+    watchdog_stall_events: int = 512  # static liveness-stall threshold
 
     def __post_init__(self):
         if self.epoch_handoff and self.handoff_epochs < 1:
@@ -186,6 +199,11 @@ class SimParams:
                 f"flight_cap must be >= 1 when telemetry is on "
                 f"(got {self.flight_cap}); the flight-recorder ring "
                 "write indices are taken modulo flight_cap")
+        if self.watchdog and self.watchdog_stall_events < 1:
+            raise ValueError(
+                f"watchdog_stall_events must be >= 1 when the watchdog is "
+                f"on (got {self.watchdog_stall_events}); a zero threshold "
+                "would trip the liveness-stall detector on every event")
 
     @property
     def lam_fp(self) -> int:
@@ -692,3 +710,8 @@ class SimState:
     # slot).
     metrics: Array      # [M] int32
     flight: Array       # [K, FR_COLS] int32
+    # Consensus watchdog plane (telemetry/stream.py; zero-width when
+    # SimParams.watchdog is off): detector state + trip counters — see
+    # stream.WD_SLOTS.  Trip counts ride the fleet digest on the
+    # run_sharded halt poll, so anomalies surface live.
+    wd: Array           # [WD] int32

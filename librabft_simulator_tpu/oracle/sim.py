@@ -464,6 +464,15 @@ class OracleSim:
             commit_lat_miss=0,            # committed block left the window
             flight=[],                    # (kind, actor, time, round, depth)
         )
+        # Consensus-watchdog mirror (telemetry/stream.py WD_SLOTS, serial
+        # per-event semantics — the lane engine's stall/queue_sat detectors
+        # accumulate at window granularity and may legitimately differ;
+        # sync_jump/round_regress/safety_conflict are per-event functions
+        # of the shared trajectory and match both engines).  Tracked
+        # unconditionally (cheap); digest() zeroes it when p.watchdog is
+        # off, mirroring the device's zero-width wd leaf.
+        self.wd = dict(stall_ev=0, stall=0, queue_sat=0, sync_jump=0,
+                       safety_conflict=0, round_regress=0)
 
     def _select_event(self):
         p = self.p
@@ -545,6 +554,7 @@ class OracleSim:
 
         self.tel["ev_kind"][KIND_TIMER if is_timer else kind] += 1
         cc_pre = cx.commit_count  # pre-handler, matching the device's cx_a
+        sync_pre = cx.sync_jumps  # pre-handler, for the sync-jump detector
 
         should_sync = False
         if is_notify:
@@ -699,6 +709,36 @@ class OracleSim:
             kind=KIND_TIMER if is_timer else kind, actor=a, time=clock,
             round=s.current_round, depth=qtot))
 
+        # Consensus-watchdog mirror (device: sim/simulator.py's watchdog
+        # block).  Every detector is a per-event function of the same
+        # pre/post values the device compares, so counts pin bit-exactly.
+        wd = self.wd
+        switched = do_update and pm.active_round > pm_round_before
+        stall_ev0 = wd["stall_ev"]
+        wd["stall_ev"] = 0 if switched else stall_ev0 + 1
+        T = self.p.watchdog_stall_events
+        if stall_ev0 < T <= wd["stall_ev"]:
+            wd["stall"] += 1
+        if qtot >= self.p.queue_cap:
+            wd["queue_sat"] += 1
+        wd["sync_jump"] += cx.sync_jumps - sync_pre
+        if cx.commit_count > cc_pre:
+            H = p.commit_log
+            pos = (cx.commit_count - 1) % H
+            d_new, t_new = cx.log_depth[pos], cx.log_tag[pos]
+            if cx.commit_count >= 2:
+                pos2 = (cx.commit_count - 2) % H
+                same_epoch = (d_new // p.commands_per_epoch
+                              == cx.log_depth[pos2] // p.commands_per_epoch)
+                if same_epoch and cx.log_round[pos] <= cx.log_round[pos2]:
+                    wd["round_regress"] += 1
+            conflict = any(
+                cb.log_depth[j] == d_new and cb.log_tag[j] != t_new
+                for b, cb in enumerate(self.ctxs) if b != a
+                for j in range(min(cb.commit_count, H)))
+            if conflict:
+                wd["safety_conflict"] += 1
+
         self.clock = clock
         self.stamp_ctr += total_consumed
         self.n_events += 1
@@ -709,6 +749,30 @@ class OracleSim:
                 break
             self.step()
         return self
+
+    def digest(self) -> dict:
+        """This instance's fleet-health digest, named per DIGEST_SLOTS
+        (telemetry/stream.py) — the host mirror of the device's in-graph
+        ``compute_digest`` on a one-instance state.  Fold per-instance
+        digests (plus ``pad_digest()`` rows for padding) with
+        ``stream.fold_digests`` to pin a whole padded fleet's polled
+        vector exactly.  Watchdog slots read 0 when ``p.watchdog`` is
+        off, mirroring the device's zero-width wd leaf."""
+        from ..telemetry import stream as tstream
+
+        d = dict(
+            halted=int(self.halted),
+            events=self.n_events,
+            commits=sum(cx.commit_count for cx in self.ctxs),
+            drops=self.n_msgs_dropped,
+            overflow=self.n_queue_full,
+            queue_depth_max=sum(1 for m in self.queue if m.valid),
+            committed_round_min=min(s.hcr for s in self.stores),
+            committed_round_max=max(s.hcr for s in self.stores),
+        )
+        for name in tstream.WD_DETECTORS:
+            d["wd_" + name] = self.wd[name] if self.p.watchdog else 0
+        return d
 
     def committed_chain(self, node):
         cx = self.ctxs[node]

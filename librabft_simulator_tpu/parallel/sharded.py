@@ -10,9 +10,10 @@ scales with the chip count.  This module is the production runtime for
 that:
 
 * **Pipelined dispatch** (:func:`run_sharded`): the compiled chunk returns
-  an in-graph ``halted_count`` int32 scalar (one word to the host per
-  chunk, never the ``[B]`` halt plane), and the host loop is
-  double-buffered — chunk *k+1* is enqueued before chunk *k*'s scalar is
+  an in-graph ``[D]`` fleet-health digest (telemetry/stream.py — slot 0 is
+  the halted count, the rest live observability; one small vector to the
+  host per chunk, never the ``[B]`` halt plane), and the host loop is
+  double-buffered — chunk *k+1* is enqueued before chunk *k*'s digest is
   polled, so poll latency overlaps device compute.  Buffer donation
   threads the fleet state in place between chunks (at B=100k the ~3.4 GB
   state is never copied).
@@ -56,6 +57,7 @@ from jax.experimental.shard_map import shard_map
 from ..core import config
 from ..core.types import SimParams
 from ..sim import simulator as sim_ops
+from ..telemetry import stream as tstream
 from ..utils import hashing as H
 from ..utils import xops
 from . import mesh as mesh_ops
@@ -145,11 +147,15 @@ def unpad(state, n_valid: int):
 
 def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
                         engine=None, wrap: str = "shard_map"):
-    """jit-compiled sharded chunk runner: ``st -> (st, halted_count)``.
+    """jit-compiled sharded chunk runner: ``st -> (st, digest)``.
 
-    ``halted_count`` is an in-graph int32 scalar — ``sum(state.halted)``
-    reduced across the mesh — so the host's per-chunk halt poll transfers
-    ONE word instead of the full ``[B]`` bool plane.
+    ``digest`` is the in-graph ``[D]`` int32 fleet-health vector
+    (telemetry/stream.py) — slot 0 is ``sum(state.halted)`` reduced across
+    the mesh, the rest are events/commits/drops/overflow, live queue
+    pressure, min/max committed round, and watchdog trip counts — so the
+    host's per-chunk halt poll transfers one small vector instead of the
+    full ``[B]`` bool plane, and live fleet visibility rides the sync the
+    host already pays for.
 
     ``wrap="shard_map"`` (default): the engine's chunk scan
     (``engine.make_scan_fn``) is staged under ``shard_map``, so every shard
@@ -202,8 +208,10 @@ def _cached_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
 
         def local(st):
             st = inner(st)
-            cnt = jax.lax.psum(jnp.sum(st.halted.astype(I32)), axes)
-            return st, cnt
+            # Whole-fleet [D] digest: psum/pmax/pmin across the mesh, so
+            # every shard returns the same (replicated) vector.
+            dg = tstream.compute_digest(p, st, axis_names=axes)
+            return st, dg
 
         f = shard_map(local, mesh=mesh, in_specs=(P(axes),),
                       out_specs=(P(axes), P()), check_rep=False)
@@ -217,28 +225,31 @@ def _cached_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
     def sharded(st):
         st = jax.lax.with_sharding_constraint(st, sh)
         st = run(st)
-        return st, jnp.sum(st.halted.astype(I32))
+        # Global reductions: GSPMD partitions them; the digest value is
+        # identical to the shard_map form's.
+        return st, tstream.compute_digest(p, st)
 
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def _poll_halt_count(cnt) -> int:
-    """Blocking host fetch of a chunk's halt scalar — ONE int32, never a
-    ``[B]`` plane.  The single host-sync point of the fleet loop, split out
-    so tests can monkeypatch it and assert exactly that
-    (tests/test_multichip.py::test_poll_path_fetches_scalars_only)."""
-    return int(jax.device_get(cnt))
+def _poll_digest(dg) -> np.ndarray:
+    """Blocking host fetch of a chunk's ``[D]`` digest — ONE small vector,
+    never a ``[B]`` plane.  The single host-sync point of the fleet loop
+    (slot 0 is the halt count; live fleet health rides along for free),
+    split out so tests can monkeypatch jax.device_get and assert exactly
+    that (tests/test_multichip.py::test_poll_path_fetches_digest_only)."""
+    return np.asarray(jax.device_get(dg))
 
 
 def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
                 chunk: int = 256, engine=None, pipeline: bool = True,
-                wrap: str = "shard_map", pad: bool = True):
+                wrap: str = "shard_map", pad: bool = True, stream=None):
     """Pipelined host loop over sharded chunks until the whole fleet halts
     or ``num_steps`` is reached; returns the (unpadded) final state.
 
     Double-buffered dispatch: chunk *k+1* is enqueued BEFORE chunk *k*'s
-    halt scalar is polled, so the host's one blocking sync per chunk
-    (:func:`_poll_halt_count`, on the LAGGED future only) overlaps device
+    digest is polled, so the host's one blocking sync per chunk
+    (:func:`_poll_digest`, on the LAGGED future only) overlaps device
     compute and the dispatch queues never drain between chunks.  The one
     extra chunk this can run after global halt is a no-op by construction
     (every engine write is gated on ``live = ~halted``), so trajectories
@@ -248,7 +259,13 @@ def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
     device count with pre-halted instances and strips them on return —
     note that stripping lands a padded fleet's final state on host,
     shard by shard (see :func:`unpad`); an evenly-dividing B returns the
-    sharded device state as-is."""
+    sharded device state as-is.
+
+    ``stream`` (a telemetry/stream.TimelineRecorder) receives every polled
+    digest — the live fleet-health timeline costs ZERO additional host
+    syncs because the digest IS the halt poll.  Every dispatched chunk is
+    polled exactly once (the final in-flight chunk included), so the
+    timeline always ends on the fleet's true final digest."""
     eng = engine if engine is not None else sim_ops
     n_valid = batch_size(state)
     if pad:
@@ -263,20 +280,32 @@ def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
     if num_steps <= 0:  # a zero step budget runs nothing (placement only)
         return unpad(state, n_valid)
     run = make_sharded_run_fn(p, mesh, chunk, engine=eng, wrap=wrap)
-    state, cnt = run(state)
+    if stream is not None:
+        stream.set_fleet(total=b_total, n_valid=n_valid)
+    halted_slot = tstream.SLOT["halted"]
+
+    def poll(dg, done_steps) -> bool:
+        d = _poll_digest(dg)
+        if stream is not None:
+            stream.record(d, steps=done_steps)
+        return int(d[halted_slot]) >= b_total
+
+    state, dg = run(state)
     done = chunk
-    while done < num_steps:
-        if not pipeline:
-            if _poll_halt_count(cnt) == b_total:
-                break
-            state, cnt = run(state)
+    if pipeline:
+        while done < num_steps:
+            lagged = dg
+            state, dg = run(state)  # dispatch k+1 before polling chunk k
             done += chunk
-            continue
-        lagged = cnt
-        state, cnt = run(state)  # dispatch k+1 before polling chunk k
-        done += chunk
-        if _poll_halt_count(lagged) == b_total:
-            break
+            if poll(lagged, done - chunk):
+                break
+        poll(dg, done)  # the final (possibly in-flight) chunk
+    else:
+        while True:
+            if poll(dg, done) or done >= num_steps:
+                break
+            state, dg = run(state)
+            done += chunk
     return unpad(state, n_valid)
 
 

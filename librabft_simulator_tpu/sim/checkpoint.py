@@ -97,6 +97,12 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
                 # soft state, restored empty from older checkpoints.
                 leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
+            if field == "wd":
+                # Round 9's consensus-watchdog plane: detector soft state,
+                # restored empty (counters restart) from pre-stream
+                # checkpoints — same synthesis as the telemetry leaves.
+                leaves.append(np.zeros(leaf.shape, leaf.dtype))
+                continue
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]
         if arr.shape != leaf.shape:
@@ -111,9 +117,9 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
                 # diagnostic soft state — restart it empty.
                 leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
-            if field in ("metrics", "flight"):
-                # telemetry/flight_cap changed between save and resume:
-                # observability soft state — restart it empty.
+            if field in ("metrics", "flight", "wd"):
+                # telemetry/flight_cap/watchdog changed between save and
+                # resume: observability soft state — restart it empty.
                 leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
             raise ValueError(f"{key}: shape {arr.shape} != {leaf.shape}")

@@ -86,6 +86,7 @@ from ..core.types import (
     unpack_payload,
 )
 from ..telemetry import plane as tplane
+from ..telemetry import stream as tstream
 from ..telemetry.profiling import scope
 from ..utils import hashing as H
 from ..utils import xops
@@ -151,6 +152,9 @@ class PSimState:
     # zero-width when SimParams.telemetry is off.
     metrics: jnp.ndarray
     flight: jnp.ndarray
+    # Consensus watchdog plane (telemetry/stream.py); zero-width when
+    # SimParams.watchdog is off.
+    wd: jnp.ndarray
 
 
 @struct.dataclass
@@ -190,6 +194,7 @@ class PackedPSimState:
     trace_count: jnp.ndarray
     metrics: jnp.ndarray
     flight: jnp.ndarray
+    wd: jnp.ndarray
 
 
 _PSIM_COMMON = packing._common_fields(PSimState)
@@ -304,6 +309,7 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         trace_count=_i32(0),
         metrics=tplane.init_plane(p),
         flight=tplane.init_flight(p),
+        wd=tstream.init_wd(p),
     )
 
 
@@ -373,15 +379,23 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     g_is = st.in_stamp[sel]
     g_isnd = st.in_sender[sel]
     g_ipay = st.in_pay[sel]
+    # Watchdog conflict reference: every node's committed log as of the
+    # window start (lanes' own rows are superseded by their carried ctx;
+    # the not_self mask below excludes them).  Packed layouts unpack views.
+    if p.watchdog:
+        wd_ctx_all = (packing.unpack_node(p, st.planes)[3] if p.packed
+                      else st.ctx)
 
     def drain_iter(c, _):
+        (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
+         ev_n, drop_n, tr_n, tr_r, tr_t, tr_c) = c[:15]
+        extra = 15
+        m = fl = wd = None
         if p.telemetry:
-            (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
-             ev_n, drop_n, tr_n, tr_r, tr_t, tr_c, m, fl) = c
-        else:
-            (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe,
-             ev_n, drop_n, tr_n, tr_r, tr_t, tr_c) = c
-            m = fl = None
+            m, fl = c[extra], c[extra + 1]
+            extra += 2
+        if p.watchdog:
+            wd = c[extra]
         pm_pre_round = g_pm.active_round  # [A] for the round-switch trace
         pm_pre_start = g_pm.round_start   # [A] for the round-latency histogram
         pre_cc = g_cx.commit_count        # [A] for the commit-latency histogram
@@ -525,6 +539,92 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
             tr_t = tr_t.at[tpos].set(t_l, mode="drop")
         tr_c = tr_c + jnp.sum(switched_tr)
 
+        # ---- Consensus watchdog for this drain iteration (lane-wise
+        # masks over the tiny [WD] plane; compiled out when
+        # SimParams.watchdog is off).  queue_sat is a window-level signal
+        # and accumulates after routing, outside the scan.
+        if p.watchdog:
+            with scope("watchdog"):
+                T = p.watchdog_stall_events
+                # Liveness stall: events drained since ANY lane advanced a
+                # pacemaker round; a switch anywhere resets the counter for
+                # the whole instance (the instance IS making progress).
+                stall_ev0 = wd[tstream.WD_STALL_EV]
+                stall_ev = jnp.where(jnp.any(switched_tr), 0,
+                                     stall_ev0 + jnp.sum(act))
+                stall_trip = (stall_ev0 < T) & (stall_ev >= T)
+                sj_inc = jnp.sum(g_cx.sync_jumps - pre_sync)
+                # Safety invariants on each committed lane's NEWEST entry.
+                comm = g_cx.commit_count > pre_cc  # [A]
+                Hl = p.commit_log
+                pick = lambda arr, idx: jnp.take_along_axis(  # noqa: E731
+                    arr, idx[:, None], axis=1)[:, 0]
+                pos = jnp.remainder(
+                    jnp.maximum(g_cx.commit_count - 1, 0), Hl)
+                pos2 = jnp.remainder(
+                    jnp.maximum(g_cx.commit_count - 2, 0), Hl)
+                d_new, t_new = pick(g_cx.log_depth, pos), pick(
+                    g_cx.log_tag, pos)
+                r_new, r_prev = pick(g_cx.log_round, pos), pick(
+                    g_cx.log_round, pos2)
+                same_epoch = (d_new // p.commands_per_epoch
+                              == pick(g_cx.log_depth, pos2)
+                              // p.commands_per_epoch)
+                regress = (comm & (g_cx.commit_count >= 2) & same_epoch
+                           & (r_new <= r_prev))
+                # Conflicting commit at the same height — the serial
+                # semantics (a commit trips iff a conflicting entry EXISTS
+                # in another node's log at commit time), assembled from the
+                # two places an entry can live mid-window: (a) every node's
+                # window-start log (wd_ctx_all — exact for non-lane nodes,
+                # which cannot commit during the window; own rows excluded,
+                # own depths strictly increase); (b) the other LANES'
+                # carried logs (g_cx), which hold this window's commits
+                # from earlier drain iterations too.  Entries written in
+                # THIS iteration count only for higher-index lanes (the
+                # causally-independent pair maps to two serial events in
+                # either order, and serial trips exactly once — at the
+                # later one).
+                entry_ok = (jnp.arange(Hl)[None, :] < jnp.minimum(
+                    wd_ctx_all.commit_count, Hl)[:, None])      # [N, Hl]
+                hit = (entry_ok[None]
+                       & (wd_ctx_all.log_depth[None]
+                          == d_new[:, None, None])
+                       & (wd_ctx_all.log_tag[None]
+                          != t_new[:, None, None]))             # [A, N, Hl]
+                not_self = sel[:, None] != jnp.arange(n)[None, :]
+                nl = d_new.shape[0]
+                cc_l = g_cx.commit_count                        # [A] post
+                qpos = jnp.arange(Hl)[None, :]
+                entry_ok_l = qpos < jnp.minimum(cc_l, Hl)[:, None]
+                # Ring position -> commit ordinal (latest write at q);
+                # ordinals >= the iteration-start count are this
+                # iteration's entries.
+                ord_l = (cc_l[:, None] - 1
+                         - jnp.remainder(cc_l[:, None] - 1 - qpos, Hl))
+                new_l = ord_l >= pre_cc[:, None]                # [A, Hl]
+                lane_hit = (entry_ok_l[None]
+                            & (g_cx.log_depth[None]
+                               == d_new[:, None, None])
+                            & (g_cx.log_tag[None]
+                               != t_new[:, None, None]))        # [A, A, Hl]
+                li = jnp.arange(nl)[:, None, None]
+                lj = jnp.arange(nl)[None, :, None]
+                seen = ~new_l[None] | (li > lj)  # same-iter: count once
+                conflict = comm & (
+                    jnp.any(hit & not_self[:, :, None], axis=(1, 2))
+                    | jnp.any(lane_hit & (li != lj) & seen, axis=(1, 2)))
+                wd = jnp.stack([
+                    stall_ev,
+                    wd[tstream.WD_STALL] + stall_trip.astype(I32),
+                    wd[tstream.WD_QUEUE_SAT],
+                    wd[tstream.WD_SYNC_JUMP] + sj_inc,
+                    wd[tstream.WD_SAFETY_CONFLICT]
+                    + jnp.sum(conflict.astype(I32)),
+                    wd[tstream.WD_ROUND_REGRESS]
+                    + jnp.sum(regress.astype(I32)),
+                ]).astype(I32)
+
         # ---- Telemetry accumulation for this drain iteration (lane-wise
         # masks; compiled out when SimParams.telemetry is off).
         if p.telemetry:
@@ -573,6 +673,8 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
               ev_n, drop_n, tr_n, tr_r, tr_t, tr_c)
         if p.telemetry:
             c2 = c2 + (m, fl)
+        if p.watchdog:
+            c2 = c2 + (wd,)
         return c2, (go, kinds, recvs, stamps, arrive, pay_sel, banks)
 
     if p.packed:
@@ -592,16 +694,19 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         st.trace_node, st.trace_round, st.trace_time, st.trace_count)
     if p.telemetry:
         carry0 = carry0 + (st.metrics, st.flight)
+    if p.watchdog:
+        carry0 = carry0 + (st.wd,)
     with scope("lane_drain"):
         carryN, ys = jax.lax.scan(drain_iter, carry0, None, length=K)
+    (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe, ev_n,
+     drop_n, trace_node, trace_round, trace_time, trace_count) = carryN[:15]
+    _extra = 15
     if p.telemetry:
-        (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe, ev_n,
-         drop_n, trace_node, trace_round, trace_time, trace_count,
-         metrics, flight) = carryN
+        metrics, flight = carryN[_extra], carryN[_extra + 1]
+        _extra += 2
     else:
-        (g_store, g_pm, g_nx, g_cx, g_iv, g_timer, g_ctr, g_hop, g_hoe, ev_n,
-         drop_n, trace_node, trace_round, trace_time, trace_count) = carryN
         metrics, flight = st.metrics, st.flight
+    wd_plane = carryN[_extra] if p.watchdog else st.wd
     go_k, kind_k, recv_k, stamp_k, arrive_k, paysel_k, bank_k = ys  # [K, A, .]
 
     # ---- Scatter lane state back (sel indices are distinct; inactive lanes
@@ -680,6 +785,19 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
 
     delivered = jnp.sum(place_m)
 
+    # ---- Window-level watchdog: queue-pressure saturation — any receiver
+    # inbox full after this window's routing.  One-hot add over the [WD]
+    # plane (static offset).
+    if p.watchdog:
+        qsat = live & jnp.any(
+            jnp.sum(in_valid2.astype(I32), axis=1) >= ic)
+        wd_plane = wd_plane + jnp.where(
+            jnp.arange(tstream.WD_WIDTH) == tstream.WD_QUEUE_SAT,
+            qsat.astype(I32), 0)
+        wd_updates = dict(wd=wd_plane)
+    else:
+        wd_updates = {}
+
     # ---- Window-level telemetry: occupancy/stall health of the
     # conservative window plus post-routing queue pressure.
     if p.telemetry:
@@ -705,6 +823,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     return st.replace(
         **node_updates,
         **tel_updates,
+        **wd_updates,
         ho_pay=ho_pay, ho_epoch=ho_epoch,
         in_valid=in_valid2, in_time=in_time2, in_kind=in_kind2,
         in_stamp=in_stamp2, in_sender=in_sender2, in_pay=in_pay2,
@@ -761,6 +880,20 @@ def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
                    donate_argnums=(3,))
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_digest_run(p_structural: SimParams, num_steps: int,
+                         batched: bool):
+    """Window-chunk scan returning ``(state, [D] digest)`` — the lane
+    engine's flavor of the stream contract (see simulator's twin)."""
+    run = _scan_run(p_structural, num_steps, batched)
+
+    def f(delay_table, dur_table, d_min, st):
+        st = run(delay_table, dur_table, d_min, st)
+        return st, tstream.compute_digest(p_structural, st)
+
+    return jax.jit(f, donate_argnums=(3,))
+
+
 def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True,
                  d_min: int | None = None):
     """Uncompiled counterpart of :func:`make_run_fn` (same contract as
@@ -778,7 +911,7 @@ def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True,
 
 
 def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
-                d_min: int | None = None):
+                d_min: int | None = None, digest: bool = False):
     """``d_min`` overrides the lookahead (must be <= the true minimum message
     latency).  As long as no inbox overflows, any conservative value — and
     any ``active_lanes``/``drain_k`` choice — yields the SAME trajectories:
@@ -787,11 +920,14 @@ def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
     window shape changes which concurrent sends compete for free slots, so
     the discarded set — and hence the trajectory — may differ.)  The
     executable is memoized on ``p.structural()`` with the lookahead as a
-    runtime scalar, so delay/drop/horizon variants share one compile."""
+    runtime scalar, so delay/drop/horizon variants share one compile.
+    ``digest=True`` returns ``st -> (st, [D] digest)``
+    (telemetry/stream.py) exactly like the serial engine's make_run_fn."""
     dmin = d_min_of(p) if d_min is None else d_min
     assert 1 <= dmin <= d_min_of(p), (dmin, d_min_of(p))
     p = xops.resolve_params(p)
-    inner = _compiled_run(p.structural(), num_steps, batched)
+    maker = _compiled_digest_run if digest else _compiled_run
+    inner = maker(p.structural(), num_steps, batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
     dmin_arr = jnp.asarray(dmin, I32)
@@ -811,11 +947,16 @@ RUN_MAX_CHUNKS = 400
 
 def run_to_completion(p: SimParams, st: PSimState, chunk: int = RUN_CHUNK,
                       max_chunks: int = RUN_MAX_CHUNKS,
-                      batched: bool = False):
-    from .simulator import dedupe_buffers
+                      batched: bool = False, stream=None):
+    from .simulator import dedupe_buffers, stream_completion
 
-    run = make_run_fn(p, chunk, batched=batched)
     st = dedupe_buffers(st)
+    if stream is not None:
+        # Digest poll contract (see simulator.stream_completion).
+        return stream_completion(
+            make_run_fn(p, chunk, batched=batched, digest=True), st,
+            chunk, max_chunks, batched, stream)
+    run = make_run_fn(p, chunk, batched=batched)
     for _ in range(max_chunks):
         st = run(st)
         if bool(np.all(jax.device_get(st.halted))):

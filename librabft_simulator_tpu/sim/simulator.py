@@ -48,6 +48,7 @@ from ..core.types import (
     unpack_payload,
 )
 from ..telemetry import plane as tplane
+from ..telemetry import stream as tstream
 from ..telemetry.profiling import scope
 from ..utils import hashing as H
 from ..utils import xops
@@ -124,6 +125,7 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         trace_count=_i32(0),
         metrics=tplane.init_plane(p),
         flight=tplane.init_flight(p),
+        wd=tstream.init_wd(p),
     )
 
 
@@ -419,6 +421,60 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         trace_node, trace_round, trace_time = (
             st.trace_node, st.trace_round, st.trace_time)
 
+    # ---- Consensus watchdog (telemetry/stream.py).  Elementwise updates
+    # over the tiny [WD] plane only — no scalar scatters — and compiled out
+    # entirely when SimParams.watchdog is off.
+    if p.watchdog:
+        with scope("watchdog"):
+            wd = st.wd
+            T = p.watchdog_stall_events
+            # Liveness stall: processed events since the handled fleet last
+            # advanced a pacemaker round (only the handled node can advance
+            # in this event).  Trip once per crossing of the threshold.
+            stall_ev0 = wd[tstream.WD_STALL_EV]
+            stall_ev = jnp.where(switched, 0,
+                                 stall_ev0 + jnp.where(live, 1, 0))
+            stall_trip = (stall_ev0 < T) & (stall_ev >= T)
+            # Queue-pressure saturation: post-write occupancy at capacity.
+            qsat = live & (jnp.sum(queue.valid.astype(I32)) >= cm)
+            # Sync-jump anomaly: the handled node jumped this event.
+            sj_inc = jnp.where(live, cx_f.sync_jumps - cx_a.sync_jumps, 0)
+            # Safety invariants, checked at commit time on the NEWEST
+            # committed entry: (a) round regression inside this node's own
+            # committed chain (epoch-aware via the depth-derived epoch —
+            # rounds legitimately restart at an epoch switch); (b) a
+            # conflicting commit at the same height: any OTHER node's log
+            # holds the same depth under a different tag.  Other nodes'
+            # rows are untouched this event, so st.ctx is current for them.
+            committed_wd = live & (cx_f.commit_count > cx_a.commit_count)
+            Hl = p.commit_log
+            pos = jnp.remainder(jnp.maximum(cx_f.commit_count - 1, 0), Hl)
+            pos2 = jnp.remainder(jnp.maximum(cx_f.commit_count - 2, 0), Hl)
+            d_new, t_new = cx_f.log_depth[pos], cx_f.log_tag[pos]
+            r_new, r_prev = cx_f.log_round[pos], cx_f.log_round[pos2]
+            same_epoch = (d_new // p.commands_per_epoch
+                          == cx_f.log_depth[pos2] // p.commands_per_epoch)
+            regress = (committed_wd & (cx_f.commit_count >= 2) & same_epoch
+                       & (r_new <= r_prev))
+            ctx_all = (packing.unpack_node(p, st.planes)[3] if p.packed
+                       else st.ctx)
+            entry_ok = (jnp.arange(Hl)[None, :]
+                        < jnp.minimum(ctx_all.commit_count, Hl)[:, None])
+            conflict = committed_wd & jnp.any(
+                (jnp.arange(n) != a)[:, None] & entry_ok
+                & (ctx_all.log_depth == d_new)
+                & (ctx_all.log_tag != t_new))
+            wd_updates = dict(wd=jnp.stack([
+                stall_ev,
+                wd[tstream.WD_STALL] + stall_trip.astype(I32),
+                wd[tstream.WD_QUEUE_SAT] + qsat.astype(I32),
+                wd[tstream.WD_SYNC_JUMP] + sj_inc,
+                wd[tstream.WD_SAFETY_CONFLICT] + conflict.astype(I32),
+                wd[tstream.WD_ROUND_REGRESS] + regress.astype(I32),
+            ]).astype(I32))
+    else:
+        wd_updates = {}
+
     # ---- Telemetry plane + flight recorder (telemetry/plane.py).  Every
     # update is a fusion-friendly elementwise form over the [M] plane;
     # compiled out entirely when SimParams.telemetry is off.
@@ -481,6 +537,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     return st.replace(
         **node_updates,
         **tel_updates,
+        **wd_updates,
         queue=queue,
         ho_pay=ho_pay,
         ho_epoch=ho_epoch,
@@ -573,6 +630,22 @@ def _compiled_run(p_structural: SimParams, num_steps: int, batched: bool):
                    donate_argnums=(2,))
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_digest_run(p_structural: SimParams, num_steps: int,
+                         batched: bool):
+    """The chunk scan returning ``(state, [D] digest)``: the single-chip
+    twin of the sharded runner's poll contract (telemetry/stream.py) — one
+    small in-graph vector summarizes the whole batch, so a host loop can
+    observe progress without ever fetching a [B] plane."""
+    run = _scan_run(p_structural, num_steps, batched)
+
+    def f(delay_table, dur_table, st):
+        st = run(delay_table, dur_table, st)
+        return st, tstream.compute_digest(p_structural, st)
+
+    return jax.jit(f, donate_argnums=(2,))
+
+
 def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True):
     """Uncompiled counterpart of :func:`make_run_fn`: the same chunk scan
     with tables bound but no ``jax.jit``, for callers that stage it under
@@ -588,15 +661,20 @@ def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True):
     return lambda st: run(delay_table, dur_table, st)
 
 
-def make_run_fn(p: SimParams, num_steps: int, batched: bool = True):
+def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
+                digest: bool = False):
     """lax.scan of ``num_steps`` events per instance (loop_until).
 
     The jitted executable is memoized on ``p.structural()`` — calls for
     params differing only in delay/drop/horizon reuse one compile.  The
     'auto' lowering fields (packed planes, dense writes) are resolved
-    against the active backend here, before memoization."""
+    against the active backend here, before memoization.  ``digest=True``
+    returns ``st -> (st, [D] digest)`` (telemetry/stream.py): the fleet
+    health summary computed in-graph at the chunk boundary, so callers can
+    observe progress with one small fetch instead of a [B] plane."""
     p = xops.resolve_params(p)
-    inner = _compiled_run(p.structural(), num_steps, batched)
+    maker = _compiled_digest_run if digest else _compiled_run
+    inner = maker(p.structural(), num_steps, batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
     return lambda st: inner(delay_table, dur_table, st)
@@ -615,12 +693,38 @@ RUN_CHUNK = 256
 RUN_MAX_CHUNKS = 400
 
 
+def stream_completion(run, st, chunk, max_chunks, batched, stream):
+    """The digest-poll host loop both engines' ``run_to_completion`` share
+    (telemetry/stream.py contract): ``run`` is a digest-flavor chunk fn
+    (``st -> (st, [D])``); each chunk's halt check reads the one fetched
+    digest vector — never a ``[B]`` plane — and every digest feeds the
+    recorder."""
+    b_total = (int(jax.tree_util.tree_leaves(st)[0].shape[0])
+               if batched else 1)
+    for i in range(max_chunks):
+        st, dg = run(st)
+        d = stream.record(np.asarray(jax.device_get(dg)),
+                          steps=(i + 1) * chunk)
+        if d["halted"] >= b_total:
+            break
+    return st
+
+
 def run_to_completion(p: SimParams, st: SimState, chunk: int = RUN_CHUNK,
                       max_chunks: int = RUN_MAX_CHUNKS,
-                      batched: bool = False):
-    """Host loop: run until every instance passes max_clock (for tests)."""
-    run = make_run_fn(p, chunk, batched=batched)
+                      batched: bool = False, stream=None):
+    """Host loop: run until every instance passes max_clock (for tests).
+
+    ``stream`` (a telemetry/stream.TimelineRecorder) switches the loop to
+    the digest contract: each chunk's halt check fetches the one [D]
+    digest vector instead of the halted plane, and the recorder receives
+    every digest — the single-chip flavor of run_sharded's live stream."""
     st = dedupe_buffers(st)
+    if stream is not None:
+        return stream_completion(
+            make_run_fn(p, chunk, batched=batched, digest=True), st,
+            chunk, max_chunks, batched, stream)
+    run = make_run_fn(p, chunk, batched=batched)
     for _ in range(max_chunks):
         st = run(st)
         halted = jax.device_get(st.halted)

@@ -13,6 +13,12 @@ Three pieces, mirroring the split the rest of the codebase uses:
   rings, and ``analysis/data_writer.py`` output into one run-report dict
   that ``bench.py`` and ``analysis/sweeps.py`` attach to their contract
   lines.
+* :mod:`.stream` — the live side: a fixed ``[D]`` fleet-health digest
+  riding the fleet loop's per-chunk halt poll (zero added host syncs), an
+  in-graph consensus watchdog (``SimState.wd``, gated by the static
+  ``SimParams.watchdog`` with the same zero-cost-off contract), and the
+  host ``TimelineRecorder`` / NDJSON stream ``scripts/fleet_watch.py``
+  follows live.  Slot maps are frozen behind ``REGISTRY_VERSION``.
 * :mod:`.profiling` — ``jax.named_scope`` annotations around the step's
   phases so on-chip ``jax.profiler`` traces map to code regions.
 """

@@ -12,8 +12,8 @@ loop); this module decodes everything AFTER the run:
   ``analysis/sweeps.py`` attach to their emitted contract lines (event-kind
   counts, loss tallies, queue pressure, p50/p99 latency bounds);
 * :func:`run_report` — the full merged report (+ optional DataWriter files);
-* :func:`probe_occupancy` — the engine throughput/occupancy probe that used
-  to live in ``scripts/occupancy_probe.py``.
+* :func:`probe_occupancy` — the engine throughput/occupancy probe (this IS
+  the probe API; the old ``scripts/occupancy_probe.py`` wrapper is gone).
 
 Histogram quantiles are reported as ``(lo, hi)`` *bucket bounds*: the
 geometric buckets (utils/quantile.py) bound the true quantile rather than
@@ -22,6 +22,7 @@ estimate it, which keeps the report honest about its own resolution.
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Optional
 
@@ -30,6 +31,28 @@ import numpy as np
 
 from ..utils import quantile
 from . import plane
+
+
+def require_registry_version(version, what: str = "artifact") -> None:
+    """Refuse to decode an artifact written under a different slot-map
+    registry version (telemetry/stream.REGISTRY_VERSION).
+
+    The plane/digest/watchdog slot maps are frozen per version — decoding a
+    v-N artifact with v-M code would silently misattribute slots (a
+    reordered counter reads as a different counter, not as an error), so
+    every serialized consumer (stream NDJSON, saved run-reports) carries
+    the version and hard-fails on mismatch.  ``None`` (a pre-versioning
+    artifact) is a mismatch too."""
+    from . import stream
+
+    if version != stream.REGISTRY_VERSION:
+        raise ValueError(
+            f"{what}: slot-registry version {version!r} does not match this "
+            f"build's v{stream.REGISTRY_VERSION}; the telemetry plane / "
+            "digest / watchdog slot maps are frozen per version and decoding "
+            "across versions silently corrupts reports — regenerate the "
+            "artifact with this build (or decode with the build that wrote "
+            "it)")
 
 
 def _metrics_np(st, instance: Optional[int] = None) -> np.ndarray:
@@ -227,7 +250,7 @@ def telemetry_block(p, st) -> dict:
 
 
 def run_report(p, st, instance: Optional[int] = None,
-               data_dir: Optional[str] = None) -> dict:
+               data_dir: Optional[str] = None, stream=None) -> dict:
     """The unified run-report: DataWriter summary + merged metrics + the
     decoded flight tail.  ``data_dir`` additionally writes the classic
     DataWriter files (round_switches.txt etc.) there.
@@ -235,11 +258,21 @@ def run_report(p, st, instance: Optional[int] = None,
     The DataWriter summary and the flight tail are per-instance artifacts
     (DataWriter has always required ``instance`` for batched states), so a
     batched fleet without ``instance`` reports fleet aggregates only
-    (merged metrics + telemetry block)."""
+    (merged metrics + telemetry block).
+
+    Every report carries ``registry_version`` (the frozen slot-map version
+    — see :func:`require_registry_version`) plus the final fleet-health
+    ``digest`` (telemetry/stream.py; works with telemetry off — the digest
+    reads engine counters, not the plane).  ``stream`` (the
+    TimelineRecorder that observed the run) attaches its per-chunk
+    timeline summary as ``stream``."""
     from ..analysis import data_writer as dw
+    from . import stream as tstream
 
     batched = np.asarray(jax.device_get(st.clock)).ndim > 0
-    report = {}
+    report = {"registry_version": tstream.REGISTRY_VERSION}
+    report["digest"] = tstream.decode_digest(
+        jax.device_get(tstream.compute_digest(p, st)))
     if instance is not None or not batched:
         if data_dir is not None:
             report["summary"] = dw.DataWriter(p, data_dir).write(st, instance)
@@ -254,15 +287,34 @@ def run_report(p, st, instance: Optional[int] = None,
             report["flight"] = decode_flight(p, st, instance)
         report["histogram_edges"] = [
             int(e) for e in quantile.histogram_edges()]
+    if stream is not None:
+        report["stream"] = stream.summary()
+    return report
+
+
+def save_report(path: str, report: dict) -> None:
+    """Serialize a :func:`run_report` dict to JSON (the version rides in
+    the report itself)."""
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1)
+
+
+def load_report(path: str) -> dict:
+    """Read a saved run-report back, refusing (clear error) one written
+    under a different slot-registry version — see
+    :func:`require_registry_version`."""
+    with open(path) as f:
+        report = json.load(f)
+    require_registry_version(report.get("registry_version"),
+                             what=f"run-report {path}")
     return report
 
 
 def probe_occupancy(engine, p, B: int = 512, chunk: int = 32,
                     reps: int = 3) -> dict:
-    """Engine throughput/occupancy probe (absorbed from
-    scripts/occupancy_probe.py): run ``reps`` timed chunks of ``chunk``
-    steps over a ``B``-instance fleet and report rates, overflow fraction,
-    and — when telemetry is on — the full telemetry block."""
+    """Engine throughput/occupancy probe: run ``reps`` timed chunks of
+    ``chunk`` steps over a ``B``-instance fleet and report rates, overflow
+    fraction, and — when telemetry is on — the full telemetry block."""
     from ..sim.simulator import dedupe_buffers
 
     seeds = np.arange(B, dtype=np.uint32)

@@ -1,0 +1,358 @@
+"""Live fleet health stream: the per-chunk digest, the in-graph consensus
+watchdog, and the host-side timeline.
+
+PR 2's metrics plane and PR 3's fleet runtime only meet *after* a run: the
+pipelined ``run_sharded`` loop polls one halt scalar per chunk and the
+plane is decoded once at the end, so a stalled / leaking / unsafe
+100k-instance fleet is invisible until completion.  This module makes the
+fleet observable *while it runs* without adding a single host sync — the
+digest rides the per-chunk halt poll the host already pays for:
+
+* **Digest** — a small fixed ``[D]`` int32 vector summarizing the whole
+  fleet (halted count, events, commits, drops, overflow, live queue
+  pressure, min/max committed round, watchdog trip counts), computed
+  in-graph at the end of every chunk and psum/pmax/pmin-reduced across the
+  mesh.  ``run_sharded``'s one blocking fetch per chunk transfers this
+  vector *instead of* the bare halt scalar (slot 0 IS the halt count), so
+  live visibility costs zero additional syncs and keeps double-buffering
+  intact.  The single-chip engines expose the same contract via
+  ``make_run_fn(..., digest=True)``.
+
+* **Watchdog** — an in-graph ``[WD]`` int32 plane per instance
+  (:data:`WD_SLOTS`) accumulated inside the step with the same
+  fusion-friendly elementwise discipline as the telemetry plane (no scalar
+  scatters): liveness stall (no pacemaker round advance for a static
+  threshold of processed events — the HotStuff/LibraBFT framing of
+  liveness as monitorable pacemaker progress), queue-pressure saturation,
+  sync-jump anomaly, and the safety invariants (conflicting commit at the
+  same height across nodes; round regression inside one node's committed
+  chain, epoch-aware via the depth-derived epoch).  Behind static
+  ``SimParams.watchdog``, default OFF: the off graph is bit- and
+  kernel-identical (the wd leaf is zero-width and every update is skipped
+  at trace time), pinned by tests/test_stream.py and the kernel-census CI
+  gate.
+
+* **Timeline** — :class:`TimelineRecorder` collects the per-chunk digests
+  into a host-side time series (per-chunk ev/s, halt progress, ETA), emits
+  NDJSON for ``scripts/fleet_watch.py``'s live view, and summarizes into
+  telemetry/report.py run-reports, bench.py (``BENCH_STREAM=1``) and
+  analysis/sweeps.py (``--stream-out``).
+
+The digest and plane slot maps are frozen behind :data:`REGISTRY_VERSION`:
+decoders (report.py, :func:`load_ndjson`) refuse artifacts written under a
+different version, and tests/test_stream.py pins the committed slot order,
+so reordering slots can never silently corrupt decoded reports.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+
+#: Version of the frozen slot maps (telemetry plane registration order in
+#: plane.py + the digest/watchdog orders below).  Bump when ANY slot is
+#: added, removed, or reordered; decoders hard-refuse mismatches.
+REGISTRY_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# Digest slot registry: name -> (index, mesh aggregation).  Fixed D
+# regardless of SimParams (watchdog slots read 0 when the watchdog is off),
+# so every consumer — the poll loop, NDJSON rows, the oracle mirror — sees
+# one stable schema.
+# ---------------------------------------------------------------------------
+
+SUM, MAX, MIN = "sum", "max", "min"
+
+DIGEST_SLOTS = (
+    ("halted", SUM),                # instances halted (slot 0 IS the poll)
+    ("events", SUM),                # total events processed
+    ("commits", SUM),               # total per-node commit_count
+    ("drops", SUM),                 # network drops
+    ("overflow", SUM),              # queue/inbox overflow
+    ("queue_depth_max", MAX),       # live (current) per-instance occupancy
+    ("committed_round_min", MIN),   # min over all nodes' hcr
+    ("committed_round_max", MAX),   # max over all nodes' hcr
+    ("wd_stall", SUM),              # watchdog trip counts (0 when off)
+    ("wd_queue_sat", SUM),
+    ("wd_sync_jump", SUM),
+    ("wd_safety_conflict", SUM),
+    ("wd_round_regress", SUM),
+)
+DIGEST_WIDTH = len(DIGEST_SLOTS)
+SLOT = {name: i for i, (name, _) in enumerate(DIGEST_SLOTS)}
+
+#: Watchdog detectors surfaced in the digest, in wd-plane counter order.
+WD_DETECTORS = ("stall", "queue_sat", "sync_jump", "safety_conflict",
+                "round_regress")
+
+# ---------------------------------------------------------------------------
+# Watchdog plane: per-instance [WD] int32 (zero-width when
+# SimParams.watchdog is off).  Slot 0 is internal detector state; the rest
+# are monotone trip counters (summed across the fleet by the digest).
+# ---------------------------------------------------------------------------
+
+WD_STALL_EV = 0         # events since the last pacemaker round advance
+WD_STALL = 1            # liveness-stall trips (threshold crossings)
+WD_QUEUE_SAT = 2        # steps/windows at queue/inbox saturation
+WD_SYNC_JUMP = 3        # state-sync jump anomalies observed
+WD_SAFETY_CONFLICT = 4  # conflicting commit at the same height
+WD_ROUND_REGRESS = 5    # round regression inside a committed chain
+WD_SLOTS = ("stall_ev", "stall", "queue_sat", "sync_jump",
+            "safety_conflict", "round_regress")
+WD_WIDTH = len(WD_SLOTS)
+
+
+def wd_width(p) -> int:
+    """Watchdog plane length (0 when the watchdog is off)."""
+    return WD_WIDTH if p.watchdog else 0
+
+
+def init_wd(p, shape=()):
+    """Zero watchdog plane ([WD] per instance; [0] when off)."""
+    return jnp.zeros(shape + (wd_width(p),), I32)
+
+
+# ---------------------------------------------------------------------------
+# Device-side digest.
+# ---------------------------------------------------------------------------
+
+
+def compute_digest(p, st, axis_names=None):
+    """The fleet-health digest of a (possibly batched) engine state: one
+    ``[D]`` int32 vector, fixed slots (:data:`DIGEST_SLOTS`).
+
+    Works on both engine flavors (shared queue vs per-receiver inboxes) in
+    their UNPACKED form — the chunk scans unpack at the boundary, so this
+    is always traced on ``SimState``/``PSimState``.  All reductions are
+    in-graph; with ``axis_names`` the slots additionally psum/pmax/pmin
+    across the mesh (shard_map context), so the host sees the whole-fleet
+    value from any one shard.  ``queue_depth_max`` is the CURRENT
+    occupancy (live pressure at chunk boundary), not the high-water mark —
+    the hwm lives in the telemetry plane, which needs ``telemetry`` on;
+    the digest works with everything off.  int32 throughout: a fleet
+    summing past 2**31 events will wrap — split reporting windows before
+    that."""
+    comp = {}
+    s32 = lambda x: jnp.sum(jnp.asarray(x).astype(I32))  # noqa: E731
+    comp["halted"] = s32(st.halted)
+    comp["events"] = s32(st.n_events)
+    comp["commits"] = s32(st.ctx.commit_count)
+    comp["drops"] = s32(st.n_msgs_dropped)
+    comp["overflow"] = s32(st.n_queue_full if hasattr(st, "n_queue_full")
+                           else st.n_inbox_full)
+    if hasattr(st, "queue"):  # serial engine: shared [CM] message table
+        occ = jnp.sum(st.queue.valid.astype(I32), axis=-1)
+    else:                     # lane engine: [N, IC] per-receiver inboxes
+        occ = jnp.sum(st.in_valid.astype(I32), axis=(-2, -1))
+    comp["queue_depth_max"] = jnp.max(occ).astype(I32)
+    comp["committed_round_min"] = jnp.min(st.store.hcr).astype(I32)
+    comp["committed_round_max"] = jnp.max(st.store.hcr).astype(I32)
+    if p.watchdog:
+        wd_tot = jnp.sum(st.wd.astype(I32).reshape((-1, WD_WIDTH)), axis=0)
+        for name in WD_DETECTORS:
+            comp["wd_" + name] = wd_tot[WD_SLOTS.index(name)]
+    else:
+        for name in WD_DETECTORS:
+            comp["wd_" + name] = jnp.zeros((), I32)
+    if axis_names is not None:
+        # Grouped mesh reductions: one collective per aggregation kind.
+        groups = {SUM: jax.lax.psum, MAX: jax.lax.pmax, MIN: jax.lax.pmin}
+        for agg, red in groups.items():
+            names = [n for n, a in DIGEST_SLOTS if a == agg]
+            vec = red(jnp.stack([comp[n] for n in names]), axis_names)
+            for i, n in enumerate(names):
+                comp[n] = vec[i]
+    return jnp.stack([comp[n] for n, _ in DIGEST_SLOTS]).astype(I32)
+
+
+# ---------------------------------------------------------------------------
+# Host-side decode / fold.
+# ---------------------------------------------------------------------------
+
+
+def decode_digest(vec) -> dict:
+    """A fetched ``[D]`` digest -> named dict, plus the derived
+    ``watchdog_flags`` bitmask (bit *i* set iff detector *i* of
+    :data:`WD_DETECTORS` has a nonzero trip count)."""
+    vec = np.asarray(vec).astype(np.int64)
+    if vec.shape != (DIGEST_WIDTH,):
+        raise ValueError(
+            f"digest shape {vec.shape} != ({DIGEST_WIDTH},); artifact from "
+            f"another registry version? (this build is v{REGISTRY_VERSION})")
+    out = {name: int(vec[i]) for i, (name, _) in enumerate(DIGEST_SLOTS)}
+    out["watchdog_flags"] = sum(
+        (1 << i) for i, d in enumerate(WD_DETECTORS) if out["wd_" + d] > 0)
+    return out
+
+
+def pad_digest() -> dict:
+    """The digest contribution of ONE pre-halted padding instance (see
+    parallel/sharded.pad_to_multiple): halted, everything else zero.  Lets
+    tests fold oracle per-instance digests into the padded-fleet value."""
+    d = {name: 0 for name, _ in DIGEST_SLOTS}
+    d["halted"] = 1
+    return d
+
+
+def fold_digests(rows) -> dict:
+    """Fold per-instance digest dicts (e.g. the oracle mirror's) into one
+    fleet digest with the device aggregation per slot — the host-side
+    associative twin of :func:`compute_digest`'s mesh reduction."""
+    rows = list(rows)
+    if not rows:
+        raise ValueError("fold_digests needs at least one digest row")
+    out = {}
+    for name, agg in DIGEST_SLOTS:
+        vals = [int(r[name]) for r in rows]
+        out[name] = (sum(vals) if agg == SUM
+                     else max(vals) if agg == MAX else min(vals))
+    out["watchdog_flags"] = sum(
+        (1 << i) for i, d in enumerate(WD_DETECTORS) if out["wd_" + d] > 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host timeline.
+# ---------------------------------------------------------------------------
+
+
+class TimelineRecorder:
+    """Collects per-chunk digests into a time series and (optionally)
+    streams NDJSON.
+
+    One :meth:`record` call per polled chunk: the row carries the decoded
+    digest plus derived rates (events/s since the previous chunk, halt
+    progress, a crude halted-rate ETA).  ``out`` (a path or a file-like
+    object) additionally gets one JSON line per row, preceded by a meta
+    line carrying :data:`REGISTRY_VERSION` — the live view
+    (scripts/fleet_watch.py) and :func:`load_ndjson` verify it before
+    decoding anything."""
+
+    def __init__(self, p, total_instances=None, out=None, meta=None):
+        self.p = p
+        self.total_instances = total_instances
+        self.rows = []
+        self._owns_out = isinstance(out, str)
+        self._out = open(out, "w") if self._owns_out else out
+        self._t0 = self._last_t = time.perf_counter()
+        self._last_events = 0
+        header = {
+            "kind": "meta",
+            "registry_version": REGISTRY_VERSION,
+            "digest_slots": [name for name, _ in DIGEST_SLOTS],
+            "n_nodes": p.n_nodes,
+            "watchdog": bool(p.watchdog),
+            "total_instances": total_instances,
+        }
+        if meta:
+            header.update(meta)
+        self._emit(header)
+
+    def _emit(self, obj) -> None:
+        if self._out is not None:
+            self._out.write(json.dumps(obj) + "\n")
+            self._out.flush()
+
+    def set_fleet(self, total: int, n_valid: int) -> None:
+        """Fleet geometry from the runner (parallel/sharded.run_sharded):
+        ``total`` is the PADDED instance count — what the digest's
+        ``halted`` slot counts, pre-halted padding included — and
+        ``n_valid`` the real instances.  Rows stay raw (bit-pinnable
+        against the device digest); consumers subtract
+        ``total - n_valid`` for a real-instance halt view.  Overrides a
+        constructor ``total_instances`` only when none was given."""
+        if self.total_instances is None:
+            self.total_instances = total
+        self._emit({"kind": "fleet", "total_instances": total,
+                    "n_valid": n_valid, "padding": total - n_valid})
+
+    def record(self, digest, steps=None) -> dict:
+        """Append one chunk's digest (an already-fetched ``[D]`` vector);
+        returns the derived row."""
+        t = time.perf_counter()
+        d = decode_digest(digest)
+        dt = max(t - self._last_t, 1e-9)
+        elapsed = t - self._t0
+        row = {
+            "kind": "row",
+            "chunk": len(self.rows),
+            "t_s": round(elapsed, 6),
+            "steps": steps,
+            **d,
+            "ev_per_s": round((d["events"] - self._last_events) / dt, 1),
+        }
+        if self.total_instances:
+            row["halt_frac"] = round(d["halted"] / self.total_instances, 6)
+            # Crude ETA from the mean halting rate so far; None until the
+            # first instance halts (no rate to extrapolate from).
+            row["eta_s"] = (
+                round(elapsed * (self.total_instances - d["halted"])
+                      / d["halted"], 3)
+                if d["halted"] > 0 and elapsed > 0 else None)
+        self._last_t = t
+        self._last_events = d["events"]
+        self.rows.append(row)
+        self._emit(row)
+        return row
+
+    def summary(self, tail: int = 8) -> dict:
+        """The compact block run-reports / bench rows attach: registry
+        version, chunk count, final digest, mean throughput, and the last
+        ``tail`` rows of the timeline."""
+        if not self.rows:
+            return {"registry_version": REGISTRY_VERSION, "chunks": 0}
+        last = self.rows[-1]
+        elapsed = max(last["t_s"], 1e-9)
+        return {
+            "registry_version": REGISTRY_VERSION,
+            "chunks": len(self.rows),
+            "elapsed_s": last["t_s"],
+            "final": {name: last[name] for name, _ in DIGEST_SLOTS},
+            "watchdog_flags": last["watchdog_flags"],
+            "mean_ev_per_s": round(last["events"] / elapsed, 1),
+            "timeline_tail": self.rows[-tail:],
+        }
+
+    def close(self) -> None:
+        if self._owns_out and self._out is not None:
+            self._out.close()
+            self._out = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def load_ndjson(path: str) -> tuple[dict, list[dict]]:
+    """Read a stream file back: ``(meta, rows)``.  Refuses (clear error) a
+    file written under a different :data:`REGISTRY_VERSION` — the slot maps
+    are frozen per version, and decoding across versions would silently
+    misattribute slots."""
+    from . import report
+
+    meta, rows = None, []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if obj.get("kind") == "meta":
+                report.require_registry_version(
+                    obj.get("registry_version"), what=f"stream file {path}")
+                meta = obj
+            else:
+                rows.append(obj)
+    if meta is None:
+        raise ValueError(
+            f"stream file {path} has no meta line; not a fleet-stream "
+            "NDJSON artifact (or written by a pre-stream build)")
+    return meta, rows

@@ -11,7 +11,9 @@
 # tpu_shape + 9 fusions for the metrics plane + flight recorder) plus the
 # same headroom — telemetry OFF must stay inside the original budget
 # (observability must cost zero kernels when disabled), telemetry ON must
-# stay bounded.
+# stay bounded.  The round-9 consensus watchdog gets the OFF budget as its
+# ON budget (it measured zero top-level fusion cost — see
+# KERNEL_CENSUS_r09.json and PERF_NOTES round 9).
 #
 # The 870 s pytest timeout is EXPECTED on this container (the suite is
 # XLA-compile-bound: the PR-1 baseline is DOTS_PASSED=49 at the timeout
@@ -26,6 +28,12 @@ cd "$(dirname "$0")/.."
 CENSUS_BUDGET=${CENSUS_BUDGET:-220}
 TELEMETRY_CENSUS_BUDGET=${TELEMETRY_CENSUS_BUDGET:-230}
 SHARDED_CENSUS_BUDGET=${SHARDED_CENSUS_BUDGET:-238}
+# The consensus watchdog (telemetry/stream.py) measured ZERO top-level
+# fusion cost at the bench shape (tpu_shape_watchdog == tpu_shape == 205,
+# KERNEL_CENSUS_r09.json — the detectors fuse into existing kernels), so
+# its budget equals the off budget: a regression that makes the watchdog
+# cost kernels fails here even if the off graph stays clean.
+WATCHDOG_CENSUS_BUDGET=${WATCHDOG_CENSUS_BUDGET:-220}
 TIER1_MIN_DOTS=${TIER1_MIN_DOTS:-39}
 
 echo "=== collection check ==="
@@ -50,20 +58,22 @@ dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 fails=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd FE | wc -c)
 echo "DOTS_PASSED=${dots} FAILS=${fails} rc=${rc}"
 
-echo "=== 2-shard dp fleet parity (explicit; the 870 s suite may time out before reaching test_multichip) ==="
-# The pipelined fleet runtime's tier-1 referees: 2-shard parity for both
-# engines at an odd batch, padding telemetry/oracle pinning, and the
-# scalar-only halt-poll assertion.  Runs from the persistent compile cache
-# the suite pass above already populated.
+echo "=== 2-shard dp fleet parity + stream referees (explicit; the 870 s suite may time out before reaching them) ==="
+# The fleet runtime's tier-1 referees: 2-shard parity for both engines at
+# an odd batch, padding telemetry/oracle pinning, the one-[D]-digest-per-
+# chunk halt-poll assertion, and the stream/watchdog oracle pins
+# (tests/test_stream.py).  Runs from the persistent compile cache the
+# suite pass above already populated.
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_multichip.py -q -m 'not slow' -p no:cacheprovider \
-    -p no:xdist -p no:randomly
+    tests/test_multichip.py tests/test_stream.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 parity_rc=$?
 
-echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${SHARDED_CENSUS_BUDGET} per-shard) ==="
+echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-max "${CENSUS_BUDGET}" \
     --assert-telemetry-max "${TELEMETRY_CENSUS_BUDGET}" \
+    --assert-watchdog-max "${WATCHDOG_CENSUS_BUDGET}" \
     --assert-sharded-max "${SHARDED_CENSUS_BUDGET}"
 census_rc=$?
 

@@ -1,0 +1,163 @@
+"""Live fleet-health view over a --stream-out NDJSON file.
+
+The fleet runtime's per-chunk digest poll (telemetry/stream.py) costs zero
+extra host syncs; pointing a TimelineRecorder at a file
+(``run_sharded(..., stream=TimelineRecorder(p, out=PATH))``, or
+``BENCH_STREAM=1 python bench.py`` / ``sweeps --stream-out PATH``) makes
+that stream observable from ANOTHER terminal while the run is still going:
+
+    python scripts/fleet_watch.py /tmp/fleet.ndjson            # follow live
+    python scripts/fleet_watch.py /tmp/fleet.ndjson --once     # print + exit
+    python scripts/fleet_watch.py /tmp/fleet.ndjson --summary  # final digest
+
+One line per polled chunk: halt progress (padding-corrected when the
+runner emitted a fleet meta line), events/s, commit/drop/overflow counts,
+queue pressure, round span, ETA — and a loud ``WATCHDOG`` column the
+moment any in-graph detector (liveness stall, queue saturation, sync-jump
+anomaly, safety violation) trips.  Reads are registry-version-checked
+(stream.load_ndjson refuses artifacts from another slot-map version), so
+a stale viewer can never silently misread a newer stream.
+
+No jax import anywhere: the viewer is pure host-side and starts instantly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from librabft_simulator_tpu.telemetry import report as treport  # noqa: E402
+from librabft_simulator_tpu.telemetry import stream as tstream  # noqa: E402
+
+
+def _flag_names(flags: int) -> str:
+    names = [d for i, d in enumerate(tstream.WD_DETECTORS)
+             if flags & (1 << i)]
+    return ",".join(names) if names else "-"
+
+
+class _View:
+    """Stateful row formatter: meta/fleet lines adjust the header and the
+    padding correction; row lines print one status line each."""
+
+    def __init__(self, out=sys.stdout):
+        self.out = out
+        self.total = None     # padded instance count (digest's halted basis)
+        self.padding = 0
+        self.header_done = False
+
+    def _header(self):
+        print(f"{'chunk':>5} {'t_s':>8} {'halted':>12} {'events':>10} "
+              f"{'ev/s':>10} {'commits':>8} {'drop':>6} {'ovfl':>6} "
+              f"{'qmax':>5} {'rounds':>11} {'eta_s':>8}  WATCHDOG",
+              file=self.out)
+        self.header_done = True
+
+    def feed(self, obj: dict) -> None:
+        kind = obj.get("kind")
+        if kind == "meta":
+            treport.require_registry_version(obj.get("registry_version"),
+                                             what="stream")
+            print(f"# fleet stream: n_nodes={obj.get('n_nodes')} "
+                  f"watchdog={'on' if obj.get('watchdog') else 'off'} "
+                  f"registry v{obj.get('registry_version')}", file=self.out)
+            if obj.get("total_instances"):
+                self.total = int(obj["total_instances"])
+            return
+        if kind == "fleet":
+            self.total = int(obj["total_instances"])
+            self.padding = int(obj.get("padding", 0))
+            if self.padding:
+                print(f"# fleet: {obj['n_valid']} instances "
+                      f"(+{self.padding} pre-halted padding)", file=self.out)
+            return
+        if kind != "row":
+            return
+        if not self.header_done:
+            self._header()
+        halted = obj["halted"] - self.padding
+        denom = (self.total - self.padding) if self.total else None
+        halt = f"{halted}/{denom}" if denom else f"{halted}"
+        rounds = f"{obj['committed_round_min']}..{obj['committed_round_max']}"
+        eta = obj.get("eta_s")
+        flags = obj.get("watchdog_flags", 0)
+        line = (f"{obj['chunk']:>5} {obj['t_s']:>8.2f} {halt:>12} "
+                f"{obj['events']:>10} {obj['ev_per_s']:>10.1f} "
+                f"{obj['commits']:>8} {obj['drops']:>6} {obj['overflow']:>6} "
+                f"{obj['queue_depth_max']:>5} {rounds:>11} "
+                f"{eta if eta is not None else '-':>8}  "
+                f"{_flag_names(flags)}")
+        print(line, file=self.out, flush=True)
+
+
+def follow(path: str, view: _View, poll_s: float = 0.5,
+           idle_timeout_s: float | None = None) -> None:
+    """Tail the NDJSON file live: feed every complete line as it lands,
+    keep waiting for more (a run in progress appends between polls).
+    Stops after ``idle_timeout_s`` with no new data (None = forever)."""
+    idle = 0.0
+    with open(path) as f:
+        buf = ""
+        while True:
+            chunk = f.read()
+            if chunk:
+                idle = 0.0
+                buf += chunk
+                while "\n" in buf:
+                    line, buf = buf.split("\n", 1)
+                    if line.strip():
+                        view.feed(json.loads(line))
+            else:
+                idle += poll_s
+                if idle_timeout_s is not None and idle >= idle_timeout_s:
+                    return
+                time.sleep(poll_s)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="NDJSON stream file (TimelineRecorder out=)")
+    ap.add_argument("--once", action="store_true",
+                    help="print what's in the file now and exit")
+    ap.add_argument("--summary", action="store_true",
+                    help="print only the final digest as JSON and exit")
+    ap.add_argument("--poll", type=float, default=0.5,
+                    help="follow-mode poll interval in seconds")
+    ap.add_argument("--idle-timeout", type=float, default=None,
+                    help="stop following after this many idle seconds")
+    args = ap.parse_args(argv)
+
+    if args.summary:
+        meta, rows = tstream.load_ndjson(args.path)
+        data = [r for r in rows if r.get("kind") == "row"]
+        if not data:
+            print("no rows yet", file=sys.stderr)
+            return 1
+        last = data[-1]
+        print(json.dumps({
+            "chunks": len(data), "elapsed_s": last["t_s"],
+            "final": {n: last[n] for n, _ in tstream.DIGEST_SLOTS},
+            "watchdog_flags": last["watchdog_flags"],
+            "watchdog": _flag_names(last["watchdog_flags"]),
+        }, indent=1))
+        return 0
+
+    view = _View()
+    if args.once:
+        meta, rows = tstream.load_ndjson(args.path)
+        view.feed(dict(meta, kind="meta"))
+        for r in rows:
+            view.feed(r)
+        return 0
+    follow(args.path, view, poll_s=args.poll,
+           idle_timeout_s=args.idle_timeout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

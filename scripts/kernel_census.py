@@ -133,7 +133,8 @@ def census_sharded(p: SimParams, batch: int, dp: int) -> dict:
     """Per-shard census of the dp-fleet runtime (parallel/sharded.py).
 
     Lowers + compiles the shard_map-wrapped one-chunk runner (scan length 1
-    == one step per instance, plus the in-graph halted_count reduction) on
+    == one step per instance, plus the in-graph [D] fleet-health digest —
+    telemetry/stream.py — that replaced the bare halted_count reduction) on
     a dp-shard CPU mesh and counts HLO ops.  Under shard_map the optimized
     module IS the per-shard program, so ``top_fusions`` here is the kernel
     count each dispatch engine pays per step — the dp scaling premise
@@ -169,6 +170,20 @@ MODES = {
     # KERNEL_CENSUS_r07.json) — the cost of observing must be bounded too.
     "tpu_shape_telemetry": dict(packed=True, dense_writes="dense",
                                 gate_handlers=True, telemetry=True),
+    # TPU shape + the consensus watchdog (telemetry/stream.py).  Watchdog
+    # OFF must leave tpu_shape untouched (same zero-cost-when-disabled
+    # contract as telemetry); ON pays its own budget
+    # (--assert-watchdog-max) — the per-step detectors are elementwise
+    # forms over the tiny [WD] plane, so the increment should stay small.
+    "tpu_shape_watchdog": dict(packed=True, dense_writes="dense",
+                               gate_handlers=True, watchdog=True),
+    # The full streaming configuration (telemetry + watchdog): what a
+    # production fleet runs when it both records planes and streams live
+    # digests; recorded for the artifact, gated transitively by the two
+    # budgets above.
+    "tpu_shape_telemetry_watchdog": dict(packed=True, dense_writes="dense",
+                                         gate_handlers=True, telemetry=True,
+                                         watchdog=True),
 }
 
 
@@ -185,6 +200,11 @@ def main() -> int:
                     help="exit nonzero if the tpu_shape_telemetry fusion "
                          "count exceeds this budget (CI regression gate; "
                          "recorded in KERNEL_CENSUS_r07.json)")
+    ap.add_argument("--assert-watchdog-max", type=int, default=None,
+                    help="exit nonzero if the tpu_shape_watchdog fusion "
+                         "count exceeds this budget (CI regression gate; "
+                         "the watchdog-OFF graph is covered by --assert-max "
+                         "— disabled detectors must cost zero kernels)")
     ap.add_argument("--sharded", action="store_true",
                     help="also census the per-shard dp-fleet program "
                          "(shard_map runner on a 2-shard virtual CPU mesh)")
@@ -200,12 +220,27 @@ def main() -> int:
     if args.assert_sharded_max is not None:
         args.sharded = True
 
+    from librabft_simulator_tpu.telemetry import plane as tplane
+    from librabft_simulator_tpu.telemetry import stream as tstream
+
     base = SimParams(n_nodes=args.n, delay_kind="uniform", max_clock=2**30,
                      queue_cap=max(32, 4 * args.n), unroll=args.unroll)
     out = {
         "platform": jax.default_backend(),
         "config": {"n_nodes": args.n, "batch": args.batch,
                    "queue_cap": base.queue_cap, "unroll": args.unroll},
+        # The exact observability configuration these counts were taken
+        # under: the frozen slot-map version, plane/digest/watchdog widths,
+        # and the stall threshold (a compile key — the census is invalid
+        # for a build whose registry differs).
+        "stream": {
+            "registry_version": tstream.REGISTRY_VERSION,
+            "plane_width": tplane.width(dataclasses.replace(
+                base, telemetry=True)),
+            "digest_width": tstream.DIGEST_WIDTH,
+            "wd_width": tstream.WD_WIDTH,
+            "watchdog_stall_events": base.watchdog_stall_events,
+        },
         "modes": {},
     }
     seen = {}  # identical mode dicts share one compile (cpu_default is
@@ -251,6 +286,11 @@ def main() -> int:
     if args.assert_telemetry_max is not None and tel > args.assert_telemetry_max:
         print(f"FAIL: tpu_shape_telemetry top-level fusion count {tel} "
               f"exceeds budget {args.assert_telemetry_max}", file=sys.stderr)
+        return 1
+    wdc = out["modes"]["tpu_shape_watchdog"]["top_fusions"]
+    if args.assert_watchdog_max is not None and wdc > args.assert_watchdog_max:
+        print(f"FAIL: tpu_shape_watchdog top-level fusion count {wdc} "
+              f"exceeds budget {args.assert_watchdog_max}", file=sys.stderr)
         return 1
     if args.assert_sharded_max is not None:
         sh = out["modes"]["sharded_tpu_shape"]["top_fusions"]

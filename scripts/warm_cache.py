@@ -52,20 +52,35 @@ SHAPES = [
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", "tests"))
 from fleet_shapes import (  # noqa: E402
-    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW)
+    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW, FLEET_WD_LANE_KW,
+    FLEET_WD_SER_KW)
 
-# Unsharded reference runs of the tier-1 2-shard parity pair.
+# Unsharded reference runs of the tier-1 2-shard parity pair, plus the
+# watchdog-armed twins tests/test_stream.py runs (watchdog and its stall
+# threshold are compile keys, so these are distinct executables).  For
+# watchdog shapes the child also compiles the digest flavor
+# (make_run_fn(..., digest=True)) — the [D]-vector poll contract
+# run_to_completion(stream=...) drives is its own executable.
 SHAPES += [
     ("serial", FLEET_SER_KW, FLEET_B, FLEET_CHUNK),
     ("parallel", FLEET_LANE_KW, FLEET_B, FLEET_CHUNK),
+    ("serial", FLEET_WD_SER_KW, FLEET_B, FLEET_CHUNK),
+    ("parallel", FLEET_WD_LANE_KW, FLEET_B, FLEET_CHUNK),
+    # tests/test_stream.py's queue-saturation pin: the 4-node shape on the
+    # SERIAL (shared-queue) engine, watchdog armed.
+    ("serial", FLEET_WD_LANE_KW, FLEET_B, FLEET_CHUNK),
 ]
 
 # (engine, SimParams kwargs, batch, chunk, dp): the sharded twins —
 # run_sharded pads batch to the mesh size, so warming with the same raw
-# batch reproduces the compiled shard shapes.
+# batch reproduces the compiled shard shapes (which since the stream PR
+# always carry the in-graph [D] digest on the poll path; the
+# watchdog-armed shape is the digest-enabled micro fleet
+# test_stream.py's sharded checks run).
 SHARDED_SHAPES = [
     ("serial", FLEET_SER_KW, FLEET_B, FLEET_CHUNK, 2),
     ("parallel", FLEET_LANE_KW, FLEET_B, FLEET_CHUNK, 2),
+    ("serial", FLEET_WD_SER_KW, FLEET_B, FLEET_CHUNK, 2),
 ]
 
 CHILD = r"""
@@ -95,7 +110,14 @@ if batch is None:
 else:
     st = dedupe_buffers(engine.init_batch(p, np.arange(batch, dtype=np.uint32)))
     run = engine.make_run_fn(p, chunk)
-jax.block_until_ready(run(st))
+st = run(st)
+if kw.get("watchdog") and batch is not None:
+    # The [D]-digest poll flavor (telemetry/stream.py) is a distinct
+    # executable; tests/test_stream.py drives it via
+    # run_to_completion(stream=...).  The digest run donates its input,
+    # so block on ITS outputs — the pre-donation reference is dead.
+    st, _ = engine.make_run_fn(p, chunk, digest=True)(st)
+jax.block_until_ready(st)
 print("warmed", engine_name, kw, batch)
 """
 

@@ -41,7 +41,7 @@ def _other_jax_job_running():
     visible (pytest, bench, warm_cache, probes, any librabft tooling) —
     restoring the sysctl under it would reinstate the mmap segfaults."""
     me = os.getpid()
-    needles = (b"pytest", b"bench.py", b"warm_cache", b"occupancy_probe",
+    needles = (b"pytest", b"bench.py", b"warm_cache", b"fleet_watch",
                b"component_profile", b"librabft")
     try:
         for pid in os.listdir("/proc"):

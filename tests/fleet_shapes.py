@@ -15,3 +15,15 @@ FLEET_SER_KW = {"n_nodes": 3, "window": 8, "chain_k": 2, "commit_log": 8,
 FLEET_LANE_KW = dict(FLEET_SER_KW, n_nodes=4, delay_kind="uniform")
 FLEET_B = 5        # deliberately not divisible by the 2-shard mesh
 FLEET_CHUNK = 32
+
+# Watchdog-armed twins (tests/test_stream.py + the digest-enabled fleet
+# warm shapes): same micro capacities with the in-graph consensus watchdog
+# on.  The stall threshold is low enough that micro runs actually trip the
+# liveness detector — watchdog_stall_events is a compile key (the
+# threshold is baked into the traced compare), so it must match between
+# the warmer and the suite exactly.
+FLEET_WD_STALL = 48
+FLEET_WD_SER_KW = dict(FLEET_SER_KW, watchdog=True,
+                       watchdog_stall_events=FLEET_WD_STALL)
+FLEET_WD_LANE_KW = dict(FLEET_LANE_KW, watchdog=True,
+                        watchdog_stall_events=FLEET_WD_STALL)

@@ -132,3 +132,55 @@ def test_load_checkpoint_missing_new_fields(tmp_path):
     assert int(st2.n_events) == int(st.n_events)
     np.testing.assert_array_equal(np.asarray(st2.ctx.commit_count),
                                   np.asarray(st.ctx.commit_count))
+
+
+def test_watchdog_leaf_restore(tmp_path):
+    """Round 9's consensus-watchdog plane through the checkpoint paths:
+    (1) a watchdog-on save/load round-trips the wd counters exactly;
+    (2) a pre-stream checkpoint (no wd key) restores under a watchdog-on
+        config with an EMPTY wd plane (counters restart; protocol leaves
+        exact);
+    (3) a watchdog toggle between save and resume (shape change) restarts
+        the plane empty instead of failing."""
+    from fleet_shapes import FLEET_B, FLEET_CHUNK, FLEET_WD_LANE_KW
+    from librabft_simulator_tpu.telemetry import stream as tstream
+
+    # The warmed micro fleet shape (tests/fleet_shapes.py): the checkpoint
+    # paths add no compiles of their own.  The silent node guarantees a
+    # nonzero wd counter so the round trip pins real data, not zeros.
+    p_wd = SimParams(max_clock=150, **FLEET_WD_LANE_KW)
+    seeds = np.arange(FLEET_B, dtype=np.uint32)
+    st = S.init_batch(p_wd, seeds)
+    st = st.replace(byz_silent=st.byz_silent.at[2, 0].set(True))
+    st = S.run_to_completion(p_wd, st, chunk=FLEET_CHUNK, batched=True)
+    assert np.asarray(st.wd).shape == (FLEET_B, tstream.WD_WIDTH)
+    assert np.asarray(st.wd)[:, 1:].any()  # something actually tripped
+    like = S.init_batch(p_wd, np.zeros(FLEET_B, np.uint32))
+    f = str(tmp_path / "wd.npz")
+    C.save(f, st)
+    st2 = C.load(f, p_wd, like=like)
+    np.testing.assert_array_equal(np.asarray(st2.wd), np.asarray(st.wd))
+
+    # (2) strip the wd key: the pre-PR-4 checkpoint shape.
+    data = dict(np.load(f))
+    assert "wd" in data
+    del data["wd"]
+    f_old = str(tmp_path / "old.npz")
+    np.savez_compressed(f_old, **data)
+    st3 = C.load(f_old, p_wd, like=like)
+    np.testing.assert_array_equal(
+        np.asarray(st3.wd), np.zeros((FLEET_B, tstream.WD_WIDTH), np.int32))
+    np.testing.assert_array_equal(np.asarray(st3.n_events),
+                                  np.asarray(st.n_events))
+    np.testing.assert_array_equal(np.asarray(st3.ctx.commit_count),
+                                  np.asarray(st.ctx.commit_count))
+
+    # (3) watchdog off at resume: zero-width plane, protocol leaves exact.
+    p_off = SimParams(max_clock=150, **{
+        k: v for k, v in FLEET_WD_LANE_KW.items()
+        if not k.startswith("watchdog")})
+    st4 = C.load(f, p_off,
+                 like=S.init_batch(p_off, np.zeros(FLEET_B, np.uint32)))
+    assert np.asarray(st4.wd).shape == (FLEET_B, 0)
+    np.testing.assert_array_equal(np.asarray(st4.ctx.commit_count),
+                                  np.asarray(st.ctx.commit_count))

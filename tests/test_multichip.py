@@ -13,8 +13,10 @@ engines:
 * DataWriter round-trace equality per instance;
 * padding contributes ZERO to every observable, pinned against the
   pure-Python oracle;
-* the pipelined host loop's poll path transfers scalars only (never the
-  [B] halt plane);
+* the pipelined host loop's poll path transfers exactly one small [D]
+  fleet-health digest per dispatched chunk (never the [B] halt plane);
+* the fleet flight-recorder concat lands instance-tagged rows in
+  instance-major order, each tail row-for-row the oracle's event log;
 * the mp quorum path armed by SimParams.mp_authors is live in the real
   step (degenerate n_mp=1 identity).
 
@@ -200,10 +202,34 @@ def test_padding_contributes_zero_oracle_pinned(serial_pair):
         assert mine == orc.tel["flight"][-len(mine):]
 
 
-def test_poll_path_fetches_scalars_only(mesh2, monkeypatch, serial_pair):
-    """The pipelined host loop's per-chunk halt poll transfers ONE int32 —
-    never the [B] halted plane (the pre-PR run_sharded fetched the full
-    plane every chunk)."""
+def test_fleet_flight_concat_order_oracle_pinned(serial_pair):
+    """The fleet flight-recorder concat is a deterministic, instance-major
+    sequence: for the padded (indivisible-B) 2-shard fleet, the FULL row
+    list equals instance 0's oracle event-log tail, then instance 1's, …
+    — each tagged with its instance and in chronological tail order, with
+    no padding rows interleaved anywhere.  Pinning the concat ORDER (not
+    just per-instance membership) keeps report consumers that index rows
+    positionally safe against a shard-fold reordering."""
+    from librabft_simulator_tpu.oracle.sim import OracleSim
+
+    _, st = serial_pair
+    rows = treport.fleet_flight(P_SER, st)
+    expected = []
+    for i, s in enumerate(SEEDS):
+        orc = OracleSim(P_SER, int(s)).run()
+        tail = orc.tel["flight"][-min(P_SER.flight_cap, orc.n_events):]
+        expected += [dict(r, instance=i) for r in tail]
+    assert rows == expected
+
+
+def test_poll_path_fetches_digest_only(mesh2, monkeypatch, serial_pair):
+    """The pipelined host loop's per-chunk halt poll transfers exactly ONE
+    small [D] fleet-health digest per dispatched chunk — never the [B]
+    halted plane (the pre-stream run_sharded fetched one bare scalar; the
+    pre-PR-3 one the full plane every chunk).  Zero added host syncs: the
+    digest IS the halt poll, so fetch count == dispatched chunk count."""
+    from librabft_simulator_tpu.telemetry import stream as tstream
+
     fetched = []
     real_get = jax.device_get
 
@@ -211,11 +237,25 @@ def test_poll_path_fetches_scalars_only(mesh2, monkeypatch, serial_pair):
         fetched.append(np.shape(x))
         return real_get(x)
 
+    dispatched = []
+    real_make = sharded.make_sharded_run_fn
+
+    def make_counting(*a, **kw):
+        run = real_make(*a, **kw)
+
+        def counting(st):
+            dispatched.append(1)
+            return run(st)
+
+        return counting
+
     monkeypatch.setattr(jax, "device_get", spy)
+    monkeypatch.setattr(sharded, "make_sharded_run_fn", make_counting)
     st = sharded.run_sharded(P_SER, mesh2, S.init_batch(P_SER, SEEDS),
                              num_steps=CHUNK * 200, chunk=CHUNK)
     assert len(fetched) > 0
-    assert all(s == () for s in fetched), fetched
+    assert all(s == (tstream.DIGEST_WIDTH,) for s in fetched), fetched
+    assert len(fetched) == len(dispatched)  # one poll per chunk, no extras
     monkeypatch.undo()
     assert_leaves_equal(serial_pair[0], st)
 

@@ -1,0 +1,390 @@
+"""telemetry/stream.py: the per-chunk fleet-health digest, the in-graph
+consensus watchdog, and the host timeline.
+
+The acceptance referees of the live-stream PR:
+
+(a) the digest and every watchdog detector match the pure-Python oracle
+    exactly on a seeded Byzantine fleet that actually TRIPS the liveness
+    stall and (via a doctored committed log — the modeled attacks cannot
+    break safety, which is the point of the protocol) the safety
+    invariants;
+(b) watchdog OFF is free and inert: the wd leaf is zero-width and a
+    watchdog-ON run is bit-identical to the OFF run on every common leaf
+    (the engine-identity pattern from tests/test_telemetry.py; the
+    kernel-census CI gate separately pins the OFF *graph* unchanged);
+(c) the slot registry is frozen: the committed digest/watchdog slot order
+    is pinned here, and every serialized consumer refuses an artifact from
+    another registry version;
+(d) the host timeline (TimelineRecorder / NDJSON / fleet_watch) reproduces
+    the device digests row-for-row, and the sharded runner's stream ends
+    on the fleet's true final digest.
+
+One batched run per engine covers (a), (b) and (d): instance 2 carries
+enough silent nodes to break quorum (one of the 3-node serial shape, two
+of the 4-node lane shape — one silent node of four leaves a live 3-vote
+quorum), instance 3 a doctored committed log (a pre-planted conflicting
+entry at depth 1 under a foreign tag with a regressed round), the rest
+are honest — so a single compile exercises every detector side by side
+with clean instances.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fleet_shapes import (
+    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW, FLEET_WD_LANE_KW,
+    FLEET_WD_SER_KW)
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.oracle.sim import OracleSim
+from librabft_simulator_tpu.sim import parallel_sim as PE
+from librabft_simulator_tpu.sim import simulator as S
+from librabft_simulator_tpu.telemetry import report as treport
+from librabft_simulator_tpu.telemetry import stream as tstream
+
+P_SER = SimParams(max_clock=120, **FLEET_SER_KW)
+P_WD_SER = SimParams(max_clock=120, **FLEET_WD_SER_KW)
+P_LANE = SimParams(max_clock=150, **FLEET_LANE_KW)
+P_WD_LANE = SimParams(max_clock=150, **FLEET_WD_LANE_KW)
+SEEDS = np.arange(FLEET_B, dtype=np.uint32)
+SILENT_I = 2   # instance 2: enough silent nodes to break quorum -> stall
+DOCTOR_I = 3   # instance 3: doctored committed log -> safety trips
+
+
+def silent_nodes(p):
+    """Silence the smallest node set that breaks quorum: one node of
+    three, two of four (one of four leaves a live 3-vote quorum)."""
+    return (0,) if p.n_nodes == 3 else (0, 1)
+
+
+def doctor_ctx(st, i):
+    """Plant a conflicting committed entry on instance ``i``'s node 1:
+    depth 1 under a tag no honest chain produces, with an absurdly high
+    round.  Every honest node's first commit of depth 1 then trips the
+    conflicting-commit detector, and node 1's own next commit (same epoch,
+    lower round) trips the round-regression detector.  Delivery semantics
+    are untouched (commit gating reads last_depth, not commit_count), and
+    the oracle twin below doctors the identical fields, so the doctored
+    trajectory still pins bit-exactly."""
+    cx = st.ctx
+    return st.replace(ctx=cx.replace(
+        commit_count=cx.commit_count.at[i, 1].set(1),
+        log_depth=cx.log_depth.at[i, 1, 0].set(1),
+        log_tag=cx.log_tag.at[i, 1, 0].set(0xDEADBEEF),
+        log_round=cx.log_round.at[i, 1, 0].set(999)))
+
+
+def doctor_oracle(orc):
+    cx = orc.ctxs[1]
+    cx.commit_count = 1
+    cx.log_depth[0] = 1
+    cx.log_tag[0] = 0xDEADBEEF
+    cx.log_round[0] = 999
+    return orc
+
+
+def byz_fleet_state(p, engine):
+    st = engine.init_batch(p, SEEDS)
+    for a in silent_nodes(p):
+        st = st.replace(byz_silent=st.byz_silent.at[SILENT_I, a].set(True))
+    return doctor_ctx(st, DOCTOR_I)
+
+
+def oracle_fleet(p):
+    orcs = []
+    for i, s in enumerate(SEEDS):
+        byz = [i == SILENT_I and a in silent_nodes(p)
+               for a in range(p.n_nodes)]
+        orc = OracleSim(p, int(s), byz_silent=byz)
+        if i == DOCTOR_I:
+            doctor_oracle(orc)
+        orcs.append(orc.run())
+    return orcs
+
+
+def state_digest(p, st):
+    return tstream.decode_digest(
+        jax.device_get(tstream.compute_digest(p, st)))
+
+
+def strip_wd(st):
+    b = np.asarray(st.clock).shape[:1]
+    return st.replace(wd=jnp.zeros(b + (0,), jnp.int32))
+
+
+def assert_trees_equal(a, b):
+    flat_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert len(flat_a) == len(flat_b)
+    for (pt, la), (_, lb) in zip(flat_a, flat_b):
+        path = "/".join(str(q) for q in pt)
+        assert la.dtype == lb.dtype, path
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb), path)
+
+
+@pytest.fixture(scope="module")
+def ser_wd_run(tmp_path_factory):
+    """The serial Byzantine fleet, run through the single-chip digest
+    contract with a TimelineRecorder streaming NDJSON."""
+    path = str(tmp_path_factory.mktemp("stream") / "ser.ndjson")
+    rec = tstream.TimelineRecorder(p=P_WD_SER, total_instances=FLEET_B,
+                                   out=path)
+    st = S.run_to_completion(P_WD_SER, byz_fleet_state(P_WD_SER, S),
+                             chunk=FLEET_CHUNK, batched=True, stream=rec)
+    rec.close()
+    return st, rec, path
+
+
+@pytest.fixture(scope="module")
+def ser_oracles():
+    return oracle_fleet(P_WD_SER)
+
+
+def test_registry_frozen():
+    """(c): the committed slot orders.  Reordering, inserting, or removing
+    ANY entry must bump REGISTRY_VERSION — this pin is what turns a silent
+    slot drift into a loud test failure."""
+    assert tstream.REGISTRY_VERSION == 1
+    assert tstream.DIGEST_SLOTS == (
+        ("halted", "sum"),
+        ("events", "sum"),
+        ("commits", "sum"),
+        ("drops", "sum"),
+        ("overflow", "sum"),
+        ("queue_depth_max", "max"),
+        ("committed_round_min", "min"),
+        ("committed_round_max", "max"),
+        ("wd_stall", "sum"),
+        ("wd_queue_sat", "sum"),
+        ("wd_sync_jump", "sum"),
+        ("wd_safety_conflict", "sum"),
+        ("wd_round_regress", "sum"),
+    )
+    assert tstream.DIGEST_WIDTH == 13
+    assert tstream.SLOT["halted"] == 0  # slot 0 IS the halt poll
+    assert tstream.WD_SLOTS == ("stall_ev", "stall", "queue_sat",
+                                "sync_jump", "safety_conflict",
+                                "round_regress")
+    assert tstream.WD_DETECTORS == tstream.WD_SLOTS[1:]
+    # The wd plane is sized by the params, zero-width when off.
+    assert tstream.wd_width(P_WD_SER) == tstream.WD_WIDTH == 6
+    assert tstream.wd_width(P_SER) == 0
+    assert S.init_state(P_SER, 0).wd.shape == (0,)
+    assert S.init_state(P_WD_SER, 0).wd.shape == (tstream.WD_WIDTH,)
+
+
+def test_digest_and_watchdog_match_oracle_serial(ser_wd_run, ser_oracles):
+    """(a): the fleet digest — watchdog trip counts included — equals the
+    fold of the per-instance oracle digests exactly, and the Byzantine /
+    doctored instances actually tripped the detectors being pinned."""
+    st, _, _ = ser_wd_run
+    dev = state_digest(P_WD_SER, st)
+    assert dev == tstream.fold_digests(o.digest() for o in ser_oracles)
+    assert dev["wd_stall"] >= 1            # silent node: quorum loss
+    assert dev["wd_safety_conflict"] >= 1  # doctored conflicting entry
+    assert dev["wd_round_regress"] >= 1    # doctored round regression
+    assert dev["halted"] == FLEET_B
+    assert dev["watchdog_flags"] & (1 << tstream.WD_DETECTORS.index("stall"))
+    # Per-instance wd planes: clean instances stay clean.
+    wd = np.asarray(st.wd)
+    assert wd.shape == (FLEET_B, tstream.WD_WIDTH)
+    for i in (0, 1, 4):
+        assert not wd[i, 1:].any(), i
+
+
+def test_watchdog_off_is_inert_serial(ser_wd_run):
+    """(b) for the serial engine: the OFF run of the SAME Byzantine fleet
+    is bit-identical on every common leaf — watching for anomalies must
+    never perturb the trajectory it watches."""
+    st_on, _, _ = ser_wd_run
+    st_off = S.run_to_completion(P_SER, byz_fleet_state(P_SER, S),
+                                 chunk=FLEET_CHUNK, batched=True)
+    assert st_off.wd.shape == (FLEET_B, 0)
+    assert_trees_equal(strip_wd(st_off), strip_wd(st_on))
+    # The digest works with the watchdog off too: wd slots read zero.
+    d = state_digest(P_SER, st_off)
+    assert {k: v for k, v in d.items() if not k.startswith("wd")
+            and k != "watchdog_flags"} == {
+        k: v for k, v in state_digest(P_WD_SER, st_on).items()
+        if not k.startswith("wd") and k != "watchdog_flags"}
+    assert all(d["wd_" + n] == 0 for n in tstream.WD_DETECTORS)
+    assert d["watchdog_flags"] == 0
+
+
+def test_queue_saturation_detector_oracle_pinned():
+    """The queue-pressure detector, tripped for real: the 4-node shape's
+    shared queue saturates under a silent node (timers pile up while
+    quorum stalls), and the per-event saturation count pins against the
+    oracle exactly, alongside the whole digest."""
+    p = P_WD_LANE  # 4-node shape, SERIAL (shared-queue) engine + oracle
+    st = S.init_batch(p, SEEDS)
+    st = st.replace(byz_silent=st.byz_silent.at[SILENT_I, 0].set(True))
+    st = S.run_to_completion(p, st, chunk=FLEET_CHUNK, batched=True)
+    orcs = []
+    for i, s in enumerate(SEEDS):
+        byz = [i == SILENT_I and a == 0 for a in range(p.n_nodes)]
+        orcs.append(OracleSim(p, int(s), byz_silent=byz).run())
+    dev = state_digest(p, st)
+    assert dev == tstream.fold_digests(o.digest() for o in orcs)
+    assert dev["wd_queue_sat"] >= 1
+    assert dev["overflow"] >= 1  # saturation really overflowed the queue
+
+
+@pytest.fixture(scope="module")
+def lane_wd_run():
+    return PE.run_to_completion(P_WD_LANE, byz_fleet_state(P_WD_LANE, PE),
+                                chunk=FLEET_CHUNK, batched=True)
+
+
+def test_digest_and_watchdog_lane_engine(lane_wd_run):
+    """(a) for the lane engine: the digest equals the values recomputed on
+    host from the final state leaves (the oracle replays the serial
+    engine's shared-queue trajectory, so the lane run pins against its own
+    state — the same discipline test_telemetry.py uses), the per-event
+    safety detectors trip on the doctored instance, and the sync-jump
+    counter shadows the engine's own tally exactly."""
+    st = lane_wd_run
+    dev = state_digest(P_WD_LANE, st)
+    g = lambda x: np.asarray(jax.device_get(x))  # noqa: E731
+    assert dev["halted"] == int(g(st.halted).sum()) == FLEET_B
+    assert dev["events"] == int(g(st.n_events).sum())
+    assert dev["commits"] == int(g(st.ctx.commit_count).sum())
+    assert dev["drops"] == int(g(st.n_msgs_dropped).sum())
+    assert dev["overflow"] == int(g(st.n_inbox_full).sum())
+    occ = g(st.in_valid).astype(np.int64).sum(axis=(1, 2))
+    assert dev["queue_depth_max"] == int(occ.max())
+    assert dev["committed_round_min"] == int(g(st.store.hcr).min())
+    assert dev["committed_round_max"] == int(g(st.store.hcr).max())
+    wd = g(st.wd)
+    assert dev["wd_sync_jump"] == int(g(st.ctx.sync_jumps).sum())
+    assert dev["wd_safety_conflict"] == int(
+        wd[:, tstream.WD_SAFETY_CONFLICT].sum()) >= 1
+    assert dev["wd_round_regress"] == int(
+        wd[:, tstream.WD_ROUND_REGRESS].sum()) >= 1
+    assert wd[SILENT_I, tstream.WD_STALL] >= 1  # the stalled instance
+    # Clean instances trip nothing.
+    for i in (0, 1, 4):
+        assert not wd[i, 1:].any(), i
+
+
+def test_watchdog_off_is_inert_lane(lane_wd_run):
+    """(b) for the lane engine."""
+    st_on = lane_wd_run
+    st_off = PE.run_to_completion(P_LANE, byz_fleet_state(P_LANE, PE),
+                                  chunk=FLEET_CHUNK, batched=True)
+    assert st_off.wd.shape == (FLEET_B, 0)
+    assert_trees_equal(strip_wd(st_off), strip_wd(st_on))
+
+
+def test_timeline_recorder_rows_and_ndjson(ser_wd_run, ser_oracles):
+    """(d): the recorder's rows carry the raw digests plus derived rates,
+    the final row IS the fleet's final digest, and the NDJSON file round
+    trips through load_ndjson row-for-row."""
+    st, rec, path = ser_wd_run
+    assert len(rec.rows) >= 1
+    final = state_digest(P_WD_SER, st)
+    last = rec.rows[-1]
+    assert {n: last[n] for n, _ in tstream.DIGEST_SLOTS} == {
+        n: final[n] for n, _ in tstream.DIGEST_SLOTS}
+    assert last["watchdog_flags"] == final["watchdog_flags"]
+    assert last["halt_frac"] == 1.0
+    # Monotone cumulative slots chunk over chunk.
+    for a, b in zip(rec.rows, rec.rows[1:]):
+        assert b["events"] >= a["events"]
+        assert b["halted"] >= a["halted"]
+        assert b["t_s"] >= a["t_s"]
+    # NDJSON round trip: meta carries the registry version; rows match.
+    meta, rows = tstream.load_ndjson(path)
+    assert meta["registry_version"] == tstream.REGISTRY_VERSION
+    assert meta["watchdog"] is True
+    assert [r for r in rows if r["kind"] == "row"] == rec.rows
+    # The summary block run-reports/bench attach.
+    s = rec.summary()
+    assert s["registry_version"] == tstream.REGISTRY_VERSION
+    assert s["chunks"] == len(rec.rows)
+    assert s["final"]["halted"] == FLEET_B
+    assert s["watchdog_flags"] == final["watchdog_flags"]
+
+
+def test_registry_version_refusal(tmp_path):
+    """(c): every serialized consumer refuses a foreign registry version
+    with a clear error — stream files, saved run-reports, and raw digest
+    vectors of the wrong width."""
+    bad = tmp_path / "bad.ndjson"
+    bad.write_text(json.dumps({"kind": "meta", "registry_version": 999})
+                   + "\n")
+    with pytest.raises(ValueError, match="registry version"):
+        tstream.load_ndjson(str(bad))
+    # A pre-versioning file (no meta line at all) is refused too.
+    raw = tmp_path / "raw.ndjson"
+    raw.write_text(json.dumps({"kind": "row", "halted": 1}) + "\n")
+    with pytest.raises(ValueError, match="meta line"):
+        tstream.load_ndjson(str(raw))
+    rep = tmp_path / "report.json"
+    rep.write_text(json.dumps({"registry_version": 0}))
+    with pytest.raises(ValueError, match="registry version"):
+        treport.load_report(str(rep))
+    with pytest.raises(ValueError, match="digest shape"):
+        tstream.decode_digest(np.zeros(tstream.DIGEST_WIDTH + 1, np.int32))
+
+
+def test_fold_digests_and_padding():
+    """fold_digests is the host twin of the device's mesh reduction, and
+    pad_digest models a pre-halted padding instance: halted 1, everything
+    else neutral for its slot's aggregation."""
+    pad = tstream.pad_digest()
+    assert pad["halted"] == 1 and pad["events"] == 0
+    a = dict(pad, halted=1, events=7, queue_depth_max=3,
+             committed_round_min=2, committed_round_max=5, wd_stall=1)
+    b = dict(pad, halted=0, events=4, queue_depth_max=9,
+             committed_round_min=1, committed_round_max=3)
+    f = tstream.fold_digests([a, b])
+    assert f["halted"] == 1 and f["events"] == 11
+    assert f["queue_depth_max"] == 9
+    assert f["committed_round_min"] == 1 and f["committed_round_max"] == 5
+    assert f["wd_stall"] == 1
+    assert f["watchdog_flags"] == 1 << tstream.WD_DETECTORS.index("stall")
+    with pytest.raises(ValueError, match="at least one"):
+        tstream.fold_digests([])
+
+
+def test_run_report_carries_version_and_digest(ser_wd_run, tmp_path):
+    """run_report stamps the registry version and the final digest (the
+    stream summary riding along when a recorder observed the run), and
+    save/load round-trips under the version check."""
+    st, rec, _ = ser_wd_run
+    rep = treport.run_report(P_WD_SER, st, stream=rec)
+    assert rep["registry_version"] == tstream.REGISTRY_VERSION
+    assert rep["digest"] == state_digest(P_WD_SER, st)
+    assert rep["stream"]["chunks"] == len(rec.rows)
+    path = str(tmp_path / "report.json")
+    treport.save_report(path, rep)
+    assert treport.load_report(path) == json.loads(json.dumps(rep))
+
+
+def test_sharded_stream_ends_on_true_final_digest(ser_wd_run, ser_oracles):
+    """(d) for the fleet runtime: run_sharded's per-chunk digest poll
+    (padded 2-shard mesh, B=5 -> 6) feeds the recorder a timeline whose
+    final row equals the fold of the oracle digests plus one pad_digest
+    row — the padding's only trace is its pre-halted count — and the
+    unpadded final state matches the single-chip run bit-for-bit."""
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+    from librabft_simulator_tpu.parallel import sharded
+
+    assert len(jax.devices()) >= 2, "conftest must force 8 CPU devices"
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    rec = tstream.TimelineRecorder(p=P_WD_SER)
+    st = sharded.run_sharded(P_WD_SER, mesh2, byz_fleet_state(P_WD_SER, S),
+                             num_steps=FLEET_CHUNK * 200, chunk=FLEET_CHUNK,
+                             stream=rec)
+    assert rec.total_instances == 6  # set_fleet reported the PADDED total
+    last = rec.rows[-1]
+    expect = tstream.fold_digests(
+        [o.digest() for o in ser_oracles] + [tstream.pad_digest()])
+    assert {n: last[n] for n, _ in tstream.DIGEST_SLOTS} == {
+        n: expect[n] for n, _ in tstream.DIGEST_SLOTS}
+    assert last["halted"] == 6 and last["halt_frac"] == 1.0
+    assert_trees_equal(ser_wd_run[0], st)
