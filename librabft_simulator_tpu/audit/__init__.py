@@ -1,0 +1,24 @@
+"""Static-analysis subsystem: jaxpr lint, source lint, checkify sanitizer.
+
+Four PRs of perf and observability work rest on invariants that were only
+example-tested until now — "no scalar scatters in TPU-gated graphs" (the
+miscompile class PR 1/2 designed around), "consensus state is int32/uint32
+only", "one host fetch per dispatched chunk", "knob-off graphs are
+bit-identical".  Every one of them is decidable on the traced jaxpr or the
+source AST, so this package enforces them statically:
+
+* :mod:`.graph_lint` — traces both engines' step functions (every lowering
+  flavor) and walks the ClosedJaxpr: rules R1-R6.
+* :mod:`.source_lint` — AST rules over the repo source: host-library calls
+  in traced code, unsanctioned host syncs, unregistered env knobs,
+  duplicated CI budget literals.
+* :mod:`.knobs` — the env-knob registry the source lint checks against
+  (and the README "Configuration knobs" table generator).
+* :mod:`.sanitize` — a checkify-instrumented build of both engines'
+  chunk runners behind the ``LIBRABFT_CHECKIFY`` knob; off, the engine
+  graphs are untouched (the census gates pin this transitively).
+
+``scripts/graph_audit.py`` runs every pass and gates CI via
+``--assert-clean``; see the README "Static guarantees" section for the
+rule table and the waiver protocol.
+"""

@@ -1,0 +1,707 @@
+"""Jaxpr-level graph auditor: machine-checked invariants of the traced step.
+
+Both engines' step functions are traced (``jax.make_jaxpr`` — no XLA
+compile, so a full audit costs seconds, not census minutes) in every
+lowering flavor the fleet can run — cpu_default, tpu_shape (packed planes +
+dense writes + gated handlers), the telemetry/watchdog twins, and the
+dp-sharded runner — and the resulting ClosedJaxprs are walked eqn by eqn
+against the rules below.  Per the JAX tracing model (PAPERS.md), every
+property here is decidable on the jaxpr: the graph IS the program.
+
+Rules
+-----
+
+R1  **No miscompile-class writes in TPU-gated graphs.**  The axon TPU
+    stack miscompiles vmapped *scalar* scatters at fleet batch sizes
+    (scripts/tpu_scatter_bug_repro.py; the PR-1 corruption was 21 vs
+    34,144 commits).  In any graph a TPU lowering can run (``packed`` /
+    ``dense_writes="dense"`` / gated flavors): scalar scatters and
+    scalar dynamic-update-slices with traced indices are HARD errors
+    (never waivable); *vector* scatters with traced indices — the
+    fuzz-certified, chip-validated form the inbox router and free-slot
+    ranker use — are allowed only at sites enumerated in
+    :data:`R1_WAIVERS`.  Constant-index forms (the telemetry plane's
+    static-offset slice updates) always pass.
+R2  **Integer discipline.**  Consensus state is int32/uint32/bool by
+    design (README "Determinism & parity": no device floats anywhere, so
+    trajectories are bit-identical across backends).  Every carry of
+    every ``while``/``scan``, every step output leaf, and in fact every
+    eqn output in the step graph must be integer/bool-typed; a float
+    escaping the (host-precomputed, integer-quantized) RNG-delay tables
+    into the graph is flagged at the offending eqn.
+R3  **No host callbacks.**  ``pure_callback`` / ``io_callback`` /
+    ``debug_callback`` inside a jitted step would serialize every
+    dispatch through the host — flagged anywhere in any flavor.
+R4  **Fixed-shape loop carries.**  Every ``scan``/``while`` body must
+    carry exactly the avals it receives (no shape polymorphism across
+    iterations) — the property that lets one compiled while loop serve
+    the whole run.
+R5  **Digest-only host contract.**  The sharded chunk runner's only
+    small (host-fetched) output is the ``[DIGEST_WIDTH]`` int32 digest;
+    every other output is a fleet-sized state leaf (leading dim = padded
+    batch).  This is the static form of the monkeypatched-``device_get``
+    test in tests/test_multichip.py.
+R6  **Knob-off graph equality.**  With telemetry/watchdog off the graph
+    must be *structurally identical* to the baseline — checked in its
+    strongest form: the knob-ON graph, dead-code-eliminated to its
+    consensus outputs, must equal the knob-OFF graph eqn-for-eqn
+    (``pe.dce_jaxpr``).  That proves observability is write-only — it
+    reads consensus state, nothing flows back — turning the engine
+    bit-identity tests into a static guarantee.  For ``mp_authors``: the
+    off graph must contain zero 'mp'-axis collectives inside the chunk
+    scan, and the armed (n_mp=1) graph must contain the quorum psums.
+
+Waivers: ``R1_WAIVERS`` maps (package-relative file) -> justification for
+*vector*-class traced-index writes.  Scalar-class hits cannot be waived.
+Add a waiver only with a fuzz campaign + census entry behind it, and say
+so in the justification (see README "Static guarantees").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+from typing import Any, Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.interpreters import partial_eval as pe
+
+from ..core import packing
+from ..core.types import SimParams
+from ..telemetry import stream as tstream
+
+try:  # Literal moved across jax versions; all of these are the same class.
+    from jax.extend.core import Literal  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.core import Literal  # type: ignore
+
+try:
+    from jax._src import source_info_util as _siu
+except ImportError:  # pragma: no cover
+    _siu = None
+
+#: The fixed digest width of the sharded poll contract (R5).  Pinned here
+#: *independently* of telemetry/stream.py so a registry edit that widens
+#: the digest shows up as an audit finding, not a silent contract change.
+DIGEST_WIDTH = 13
+
+# Audit micro shapes: capacity-trimmed params for fast auditing in tests
+# (tests/test_audit.py).  Observability knobs are left OFF here — the
+# auditor toggles them per flavor.  tests/fleet_shapes.py's warmed fleet
+# shapes are these plus telemetry/trace capacities.
+MICRO_SER_KW = dict(n_nodes=3, window=8, chain_k=2, commit_log=8,
+                    queue_cap=16)
+MICRO_LANE_KW = dict(MICRO_SER_KW, n_nodes=4, delay_kind="uniform")
+# The kernel-census shape (scripts/kernel_census.py defaults): what CI
+# audits, so the censused graph and the audited graph are the same trace.
+CENSUS_KW = dict(n_nodes=4, delay_kind="uniform", queue_cap=32)
+
+#: R1 vector-write waivers: package-relative file -> justification.  Only
+#: the VECTOR class is waivable; see the module docstring.
+R1_WAIVERS = {
+    "sim/simulator.py":
+        "free-slot rank assignment (step's slot_of_rank): a [<=2n+1]-index "
+        "vector scatter with unique in-range ranks + sentinel drop; not in "
+        "the scalar-scatter miscompile class, certified by the 1,222-trial "
+        "FUZZ_PACKED campaign and the round-5 on-chip parity runs.",
+    "sim/parallel_sim.py":
+        "lane scatter-back + inbox routing: [A]- and [K*A*(2n+1)]-index "
+        "vector row scatters with distinct targets (PERF_NOTES.md calls "
+        "these the proven-safe class); chip-validated at B=1024 in round 5.",
+}
+
+
+#: Pinned waived-site counts per flavor: a waiver is file-granular, so a
+#: NEW vector scatter in an already-waived engine file would silently ride
+#: the existing waiver — this pin makes it fail loudly instead.  When the
+#: count changes on purpose (site added/removed), recertify (fuzz +
+#: census) and re-pin here; the audit error text says so.
+R1_EXPECTED_WAIVED = {
+    "serial/tpu_shape": 1,        # free-slot rank scatter
+    "serial/tpu_telemetry": 1,
+    "serial/tpu_watchdog": 1,
+    "lane/tpu_shape": 13,         # lane scatter-back + inbox routing
+    "lane/tpu_telemetry": 14,     # + the flight-recorder ring scatter
+    "lane/tpu_watchdog": 13,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str        # "R1".."R6"
+    flavor: str      # e.g. "serial/tpu_shape"
+    severity: str    # "error" | "waived"
+    summary: str
+    site: str = ""   # "file:function:line" when recoverable from the trace
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr walking.
+# ---------------------------------------------------------------------------
+
+
+def _subjaxprs(params: dict) -> list:
+    """Every Jaxpr nested in an eqn's params (scan/while/cond/pjit/
+    shard_map/custom_* all stash theirs under different keys and shapes —
+    recurse by type, not by name, so new primitives keep working)."""
+    out = []
+
+    def rec(v):
+        t = type(v).__name__
+        if t == "ClosedJaxpr":
+            out.append(v.jaxpr)
+        elif t == "Jaxpr":
+            out.append(v)
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                rec(x)
+
+    for v in params.values():
+        rec(v)
+    return out
+
+
+def iter_eqns(jaxpr, depth: int = 0, in_loop: bool = False):
+    """Yield ``(depth, eqn, in_loop)`` over every eqn, recursively.
+    ``in_loop`` is True inside any scan/while body — R6's mp check needs
+    to distinguish per-iteration collectives from chunk-boundary ones."""
+    for eqn in jaxpr.eqns:
+        yield depth, eqn, in_loop
+        looped = in_loop or eqn.primitive.name in ("scan", "while")
+        for sub in _subjaxprs(eqn.params):
+            yield from iter_eqns(sub, depth + 1, looped)
+
+
+def eqn_site(eqn) -> str:
+    """Best-effort ``file:function:line`` of the user frame that created an
+    eqn (jax keeps source provenance on the trace)."""
+    if _siu is None:
+        return ""
+    try:
+        fallback = ""
+        for frame in _siu.user_frames(eqn.source_info):
+            name = frame.file_name.replace("\\", "/")
+            if "librabft_simulator_tpu" in name:
+                rel = name.split("librabft_simulator_tpu/", 1)[-1]
+                return f"{rel}:{frame.function_name}:{frame.start_line}"
+            if not fallback:
+                fallback = f"{name}:{frame.function_name}:{frame.start_line}"
+        return fallback
+    except Exception:  # noqa: BLE001 — provenance is advisory; a lost
+        pass           # site makes a vector hit UNWAIVABLE (fail-safe)
+    return ""
+
+
+def _site_file(site: str) -> str:
+    return site.split(":", 1)[0] if site else ""
+
+
+def eqn_signature(jaxpr) -> tuple:
+    """Structural signature of an eqn sequence: (primitive, output avals,
+    nested signatures), recursively.  Variable *names* and literal values
+    are excluded on purpose — two traces of the same program must compare
+    equal even though jax renumbers vars per trace."""
+    out = []
+    for eqn in jaxpr.eqns:
+        out.append((
+            eqn.primitive.name,
+            tuple(str(v.aval) for v in eqn.outvars),
+            tuple(eqn_signature(s) for s in _subjaxprs(eqn.params)),
+        ))
+    return tuple(out)
+
+
+def signature_hash(jaxpr) -> str:
+    """sha256 of the structural signature — the eqn-sequence hash recorded
+    per flavor in GRAPH_AUDIT artifacts (drift observability)."""
+    return hashlib.sha256(repr(eqn_signature(jaxpr)).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Write-op classification (R1).
+# ---------------------------------------------------------------------------
+
+_SCATTER_PRIMS = ("scatter", "scatter-add", "scatter-mul", "scatter-min",
+                  "scatter-max", "scatter_apply")
+
+
+def classify_write(eqn) -> str | None:
+    """Classify a scatter/dynamic-update-slice eqn:
+
+    ``"static"``  — constant (Literal) indices: compile-time addressing.
+    ``"scalar"``  — ONE traced-index update (the miscompile class).
+    ``"vector"``  — K>1 traced-index updates (the proven class).
+    ``None``      — not a write-op eqn.
+    """
+    name = eqn.primitive.name
+    if name in _SCATTER_PRIMS:
+        idx = eqn.invars[1]  # (operand, scatter_indices, updates)
+        if isinstance(idx, Literal):
+            return "static"
+        shape = tuple(idx.aval.shape)
+        # lax convention: the LAST indices dim is the index vector; the
+        # rest enumerate updates.  Rank-1 [k] is a single k-coordinate
+        # index (one update) — the conservative (scalar) reading.
+        n_upd = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
+        return "vector" if n_upd > 1 else "scalar"
+    if name == "dynamic_update_slice":
+        starts = eqn.invars[2:]
+        if all(isinstance(v, Literal) for v in starts):
+            return "static"
+        upd = eqn.invars[1]
+        size = int(np.prod(upd.aval.shape)) if upd.aval.shape else 1
+        return "vector" if size > 1 else "scalar"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Rule passes over one traced flavor.
+# ---------------------------------------------------------------------------
+
+
+def check_r1(jaxpr, flavor: str) -> tuple[list[Finding], dict]:
+    findings, stats = [], {"static": 0, "scalar": 0, "vector": 0,
+                           "vector_waived": 0}
+    for _, eqn, _ in iter_eqns(jaxpr):
+        cls = classify_write(eqn)
+        if cls is None:
+            continue
+        stats[cls] += 1
+        site = eqn_site(eqn)
+        if cls == "static":
+            continue
+        if cls == "scalar":
+            findings.append(Finding(
+                "R1", flavor, "error",
+                f"scalar traced-index {eqn.primitive.name} — the TPU "
+                "miscompile class (scripts/tpu_scatter_bug_repro.py); "
+                "use utils/xops.wset (one-hot where) or scatter_set",
+                site))
+        else:
+            waiver = R1_WAIVERS.get(_site_file(site))
+            if waiver:
+                stats["vector_waived"] += 1
+                findings.append(Finding(
+                    "R1", flavor, "waived",
+                    f"vector traced-index {eqn.primitive.name} (waived: "
+                    f"{waiver.split(':')[0]})", site))
+            else:
+                findings.append(Finding(
+                    "R1", flavor, "error",
+                    f"vector traced-index {eqn.primitive.name} at an "
+                    "unwaived site — if this form is deliberate, certify "
+                    "it (fuzz + census) and add an R1_WAIVERS entry",
+                    site))
+    return findings, stats
+
+
+def _loop_carries(eqn):
+    """(label, [in avals], [out avals]) for a scan/while eqn's carries."""
+    name = eqn.primitive.name
+    if name == "scan":
+        body = eqn.params["jaxpr"].jaxpr
+        nc, nconst = eqn.params["num_carry"], eqn.params["num_consts"]
+        ins = [v.aval for v in body.invars[nconst:nconst + nc]]
+        outs = [v.aval for v in body.outvars[:nc]]
+        return "scan", ins, outs
+    if name == "while":
+        body = eqn.params["body_jaxpr"].jaxpr
+        return "while", [v.aval for v in body.invars], \
+            [v.aval for v in body.outvars]
+    return None
+
+
+def _non_integer(dt) -> bool:
+    """True for any non-int/uint/bool dtype.  Allowlist, not a 'kind ==
+    f' denylist: bfloat16/float8 register under ml_dtypes with numpy kind
+    'V', and complex is 'c' — all of them must trip R2."""
+    if dt is None:
+        return False
+    return np.dtype(dt).kind not in "iub"
+
+
+def check_r2(jaxpr, flavor: str, out_avals=None) -> tuple[list[Finding], dict]:
+    findings = []
+    n_float = 0
+    for _, eqn, _ in iter_eqns(jaxpr):
+        carries = _loop_carries(eqn)
+        if carries is not None:
+            label, ins, _ = carries
+            for av in ins:
+                if _non_integer(getattr(av, "dtype", None)):
+                    findings.append(Finding(
+                        "R2", flavor, "error",
+                        f"non-integer {label} carry {av} — consensus "
+                        "state is int32/uint32/bool only", eqn_site(eqn)))
+        for v in eqn.outvars:
+            if _non_integer(getattr(v.aval, "dtype", None)):
+                n_float += 1
+                findings.append(Finding(
+                    "R2", flavor, "error",
+                    f"non-integer eqn output {v.aval} from "
+                    f"{eqn.primitive.name} — the graph is integer-only by "
+                    "design (bit-parity across backends)", eqn_site(eqn)))
+    for av in (out_avals or []):
+        if _non_integer(getattr(av, "dtype", None)):
+            findings.append(Finding(
+                "R2", flavor, "error",
+                f"non-integer step output leaf {av}", ""))
+    return findings, {"float_eqns": n_float}
+
+
+def check_r3(jaxpr, flavor: str) -> list[Finding]:
+    findings = []
+    for _, eqn, _ in iter_eqns(jaxpr):
+        if "callback" in eqn.primitive.name:
+            findings.append(Finding(
+                "R3", flavor, "error",
+                f"host callback primitive {eqn.primitive.name} inside the "
+                "jitted step — every dispatch would sync through the host",
+                eqn_site(eqn)))
+    return findings
+
+
+def check_r4(jaxpr, flavor: str) -> list[Finding]:
+    findings = []
+    for _, eqn, _ in iter_eqns(jaxpr):
+        carries = _loop_carries(eqn)
+        if carries is None:
+            continue
+        label, ins, outs = carries
+        ins_s, outs_s = [str(a) for a in ins], [str(a) for a in outs]
+        if ins_s != outs_s:
+            findings.append(Finding(
+                "R4", flavor, "error",
+                f"{label} carry avals change across iterations: "
+                f"{ins_s} -> {outs_s}", eqn_site(eqn)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Flavor tracing.
+# ---------------------------------------------------------------------------
+
+#: The concrete TPU lowering forms, resolved explicitly (NOT 'auto') so the
+#: audit checks what a TPU will run regardless of the auditing host.
+TPU_FORMS = dict(packed=True, dense_writes="dense", gate_handlers=True)
+CPU_FORMS = dict(packed=False, dense_writes="scatter", gate_handlers=False)
+
+
+def _engine(name: str):
+    if name == "serial":
+        from ..sim import simulator as S
+        return S
+    from ..sim import parallel_sim as PS
+    return PS
+
+
+def trace_step(engine_name: str, p: SimParams):
+    """``(closed_jaxpr, out_paths, out_avals)`` of one engine's
+    single-instance step at params ``p`` (packed layout applied when the
+    flavor asks for it, exactly as the compiled scan body does).  The step
+    is state-in/state-out, so the input tree's paths label the trace's
+    output leaves — no second trace needed."""
+    eng = _engine(engine_name)
+    st = eng.init_state(p, 0)
+    dt = jnp.asarray(p.delay_table())
+    du = jnp.asarray(p.duration_table())
+    if engine_name == "serial":
+        if p.packed:
+            st = packing.pack_state(p, st)
+        cj = jax.make_jaxpr(functools.partial(eng.step, p))(dt, du, st)
+    else:
+        if p.packed:
+            st = eng.pack_pstate(p, st)
+        cj = jax.make_jaxpr(
+            functools.partial(eng.step, p, dt, du, eng.d_min_of(p)))(st)
+    paths = [jax.tree_util.keystr(k) for k, _ in
+             jax.tree_util.tree_flatten_with_path(st)[0]]
+    return cj, paths, list(cj.out_avals)
+
+
+_OBS_LEAVES = (".metrics", ".flight", ".wd")
+
+
+def _consensus_dce(cj, paths) -> tuple:
+    """DCE a step trace down to its consensus outputs (observability
+    leaves dropped) and return the structural signature.  Used on BOTH
+    sides of the R6 comparison: DCE normalizes trace-level dead code, so
+    off-graph == dce(on-graph) is exactly 'nothing flows back'."""
+    used = [not any(k in pth for k in _OBS_LEAVES) for pth in paths]
+    dj, _ = pe.dce_jaxpr(cj.jaxpr, used)
+    return eqn_signature(dj)
+
+
+def check_r6_engine(engine_name: str, base_kw: dict, flavor_prefix: str,
+                    traces: dict | None = None):
+    """R6 for one engine: telemetry/watchdog ON graphs, DCE'd to consensus
+    outputs, must equal the OFF graph eqn-for-eqn.  ``traces`` lets
+    audit_engine share the flavor traces it already paid for
+    (flavor-name -> (closed_jaxpr, out_paths))."""
+    findings = []
+    traces = dict(traces or {})
+
+    def get(name, **kw):
+        if name not in traces:
+            p = SimParams(**base_kw, **TPU_FORMS, **kw)
+            cj, paths, _ = trace_step(engine_name, p)
+            traces[name] = (cj, paths)
+        return traces[name]
+
+    sig_off = _consensus_dce(*get("tpu_shape"))
+    knob_sets = {
+        "tpu_telemetry": dict(telemetry=True, flight_cap=32),
+        "tpu_watchdog": dict(watchdog=True),
+        "tpu_telemetry_watchdog": dict(telemetry=True, flight_cap=32,
+                                       watchdog=True),
+    }
+    for name, kw in knob_sets.items():
+        sig_on = _consensus_dce(*get(name, **kw))
+        if sig_on != sig_off:
+            i = next((k for k, (a, b) in enumerate(zip(sig_off, sig_on))
+                      if a != b), min(len(sig_off), len(sig_on)))
+            findings.append(Finding(
+                "R6", f"{flavor_prefix}/{name}", "error",
+                f"knob-on graph is not the off graph plus write-only "
+                f"observability: consensus-sliced eqn sequences diverge at "
+                f"eqn {i} ({len(sig_off)} off vs {len(sig_on)} on-DCE "
+                "eqns) — an observability value is feeding back into "
+                "consensus state", ""))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Sharded runner checks (R5 + the mp arm of R6).
+# ---------------------------------------------------------------------------
+
+
+def trace_sharded(p: SimParams, batch: int, dp: int):
+    from ..parallel import mesh as mesh_ops
+    from ..parallel import sharded
+    from ..sim import simulator as S
+
+    mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1, devices=jax.devices()[:dp])
+    st = S.init_batch(p, sharded.fleet_seeds(0, batch))
+    st, _ = sharded.pad_to_multiple(p, st, mesh.size)
+    padded_b = sharded.batch_size(st)
+    st = mesh_ops.shard_batch(mesh, st)
+    run = sharded.make_sharded_run_fn(p, mesh, 2)
+    return jax.make_jaxpr(run)(st), padded_b
+
+
+def check_r5(cj, padded_b: int, flavor: str) -> list[Finding]:
+    findings = []
+    if DIGEST_WIDTH != tstream.DIGEST_WIDTH:
+        findings.append(Finding(
+            "R5", flavor, "error",
+            f"digest width changed: telemetry/stream.DIGEST_WIDTH="
+            f"{tstream.DIGEST_WIDTH} vs the audited contract "
+            f"{DIGEST_WIDTH} — re-pin BOTH after bumping "
+            "REGISTRY_VERSION", ""))
+    outs = [v.aval for v in cj.jaxpr.outvars]
+    digests = [a for a in outs
+               if tuple(a.shape) == (tstream.DIGEST_WIDTH,)
+               and np.dtype(a.dtype).kind == "i"]
+    if len(digests) != 1:
+        findings.append(Finding(
+            "R5", flavor, "error",
+            f"sharded runner must return exactly one [{DIGEST_WIDTH}] "
+            f"int32 digest (found {len(digests)}) — the poll path "
+            "contract of parallel/sharded.run_sharded", ""))
+    for a in outs:
+        if tuple(a.shape) == (tstream.DIGEST_WIDTH,) \
+                and np.dtype(a.dtype).kind == "i":
+            continue
+        if not a.shape or a.shape[0] != padded_b:
+            findings.append(Finding(
+                "R5", flavor, "error",
+                f"non-state, non-digest output {a}: every extra output "
+                "is another per-chunk host transfer candidate", ""))
+    return findings
+
+
+_COLLECTIVES = ("psum", "pmax", "pmin", "all_gather", "all_reduce",
+                "ppermute", "all_to_all")
+
+
+def _mp_collectives_in_scan(cj) -> int:
+    n = 0
+    for _, eqn, in_loop in iter_eqns(cj.jaxpr):
+        if not in_loop or eqn.primitive.name not in _COLLECTIVES:
+            continue
+        axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        if "mp" in axes:
+            n += 1
+    return n
+
+
+def check_r6_mp(p_base: SimParams, batch: int, dp: int,
+                cj_off=None) -> list[Finding]:
+    """mp_authors OFF must pay zero 'mp'-axis collectives inside the chunk
+    scan; ON (n_mp=1 degenerate) must actually arm the quorum psums.
+    ``cj_off`` lets audit_sharded pass the off trace it already paid for
+    (mp_authors defaults to False, so its R5 trace IS the off graph)."""
+    findings = []
+    if cj_off is None or p_base.mp_authors:
+        cj_off, _ = trace_sharded(
+            dataclasses.replace(p_base, mp_authors=False), batch, dp)
+    n_off = _mp_collectives_in_scan(cj_off)
+    if n_off:
+        findings.append(Finding(
+            "R6", "sharded/mp_off", "error",
+            f"{n_off} 'mp'-axis collectives inside the chunk scan with "
+            "mp_authors off — the off graph must be collective-free "
+            "per iteration", ""))
+    cj_on, _ = trace_sharded(
+        dataclasses.replace(p_base, mp_authors=True), batch, dp)
+    n_on = _mp_collectives_in_scan(cj_on)
+    if n_on == 0:
+        findings.append(Finding(
+            "R6", "sharded/mp_on", "error",
+            "mp_authors=True armed zero in-scan 'mp' psums — the quorum "
+            "sites in core/store.py are no longer wired through "
+            "core/config.py's axis aggregation", ""))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# The full audit.
+# ---------------------------------------------------------------------------
+
+
+def _flavors(base_kw: dict):
+    """(name, forms, rules) per engine flavor.  cpu_default keeps its
+    proven scatter forms, so R1 (a TPU-lowering rule) does not apply."""
+    return [
+        ("cpu_default", CPU_FORMS, ("R2", "R3", "R4")),
+        ("tpu_shape", TPU_FORMS, ("R1", "R2", "R3", "R4")),
+        ("tpu_telemetry", dict(TPU_FORMS, telemetry=True, flight_cap=32),
+         ("R1", "R2", "R3", "R4")),
+        ("tpu_watchdog", dict(TPU_FORMS, watchdog=True),
+         ("R1", "R2", "R3", "R4")),
+    ]
+
+
+def audit_engine(engine_name: str, base_kw: dict, r6: bool = True,
+                 flavors=None) -> tuple[list[Finding], dict]:
+    """Run R1-R4 (+R6) over one engine's lowering flavors at shape
+    ``base_kw``; returns (findings, per-flavor stats)."""
+    findings, stats, traces = [], {}, {}
+    wanted = set(flavors) if flavors is not None else None
+    for name, forms, rules in _flavors(base_kw):
+        if wanted is not None and name not in wanted:
+            continue
+        flavor = f"{engine_name}/{name}"
+        p = SimParams(**base_kw, **forms)
+        cj, paths, out_avals = trace_step(engine_name, p)
+        if name != "cpu_default":
+            traces[name] = (cj, paths)  # R6 reuses the TPU-form traces
+        st = {"eqns": sum(1 for _ in iter_eqns(cj.jaxpr)),
+              "eqn_hash": signature_hash(cj.jaxpr)}
+        if "R1" in rules:
+            f1, s1 = check_r1(cj.jaxpr, flavor)
+            findings += f1
+            st["writes"] = s1
+            expected = R1_EXPECTED_WAIVED.get(flavor)
+            if expected is not None and s1["vector_waived"] != expected:
+                findings.append(Finding(
+                    "R1", flavor, "error",
+                    f"waived vector-scatter count changed: "
+                    f"{s1['vector_waived']} sites vs the pinned "
+                    f"{expected} — a write site was added or removed "
+                    "under an existing file waiver; recertify (fuzz + "
+                    "census) and re-pin R1_EXPECTED_WAIVED", ""))
+        if "R2" in rules:
+            f2, s2 = check_r2(cj.jaxpr, flavor, out_avals)
+            findings += f2
+            st.update(s2)
+        if "R3" in rules:
+            findings += check_r3(cj.jaxpr, flavor)
+        if "R4" in rules:
+            findings += check_r4(cj.jaxpr, flavor)
+        stats[flavor] = st
+    if r6:
+        findings += check_r6_engine(engine_name, base_kw, engine_name,
+                                    traces=traces)
+    return findings, stats
+
+
+def audit_sharded(base_kw: dict, batch: int = 5, dp: int = 2,
+                  mp: bool = True) -> tuple[list[Finding], dict]:
+    """R3/R5 (+ the mp arm of R6) on the dp-sharded serial runner."""
+    if len(jax.devices()) < dp:
+        return [Finding(
+            "R5", "sharded", "error",
+            f"cannot audit the sharded runner: {len(jax.devices())} "
+            f"devices < dp={dp}; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 before "
+            "importing jax (scripts/graph_audit.py does)", "")], {}
+    p = SimParams(**base_kw, **TPU_FORMS)
+    cj, padded_b = trace_sharded(p, batch, dp)
+    findings = check_r5(cj, padded_b, "sharded/tpu_shape")
+    findings += check_r3(cj.jaxpr, "sharded/tpu_shape")
+    if mp:
+        findings += check_r6_mp(p, batch, dp, cj_off=cj)
+    stats = {"sharded/tpu_shape": {
+        "eqns": sum(1 for _ in iter_eqns(cj.jaxpr)),
+        "eqn_hash": signature_hash(cj.jaxpr),
+        "padded_batch": padded_b,
+        "outputs": len(cj.jaxpr.outvars),
+    }}
+    return findings, stats
+
+
+def audit_all(shape: str = "census", engines=("serial", "lane"),
+              sharded: bool = True) -> dict:
+    """The whole matrix; returns the GRAPH_AUDIT artifact dict."""
+    ser_kw = dict(CENSUS_KW if shape == "census" else MICRO_SER_KW)
+    lane_kw = dict(CENSUS_KW if shape == "census" else MICRO_LANE_KW)
+    findings: list[Finding] = []
+    stats: dict[str, Any] = {}
+    for eng in engines:
+        f, s = audit_engine(eng, ser_kw if eng == "serial" else lane_kw)
+        findings += f
+        stats.update(s)
+    if sharded:
+        f, s = audit_sharded(ser_kw)
+        findings += f
+        stats.update(s)
+    errors = [f for f in findings if f.severity == "error"]
+    return {
+        "shape": shape,
+        "digest_width": tstream.DIGEST_WIDTH,
+        "registry_version": tstream.REGISTRY_VERSION,
+        "flavors": stats,
+        "findings": [f.to_json() for f in findings],
+        "n_errors": len(errors),
+        "clean": not errors,
+    }
+
+
+# --- small helpers for test fixtures ---------------------------------------
+
+
+def check_toy(fn: Callable, *args, rules=("R1", "R2", "R3", "R4"),
+              flavor: str = "toy") -> list[Finding]:
+    """Trace an arbitrary function and run the write/dtype/callback/carry
+    rules on it — the seeded-violation entry point tests/test_audit.py
+    feeds known-bad graphs through."""
+    cj = jax.make_jaxpr(fn)(*args)
+    findings = []
+    if "R1" in rules:
+        findings += check_r1(cj.jaxpr, flavor)[0]
+    if "R2" in rules:
+        findings += check_r2(cj.jaxpr, flavor)[0]
+    if "R3" in rules:
+        findings += check_r3(cj.jaxpr, flavor)
+    if "R4" in rules:
+        findings += check_r4(cj.jaxpr, flavor)
+    return findings

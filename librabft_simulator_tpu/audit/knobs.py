@@ -1,0 +1,228 @@
+"""The environment-knob registry: every env var this repo reads, in one
+place.
+
+Knobs had accreted across four PRs — lowering overrides in
+``utils/xops.py``, a dozen ``BENCH_*`` switches in ``bench.py``, fuzz and
+script locals — with no single list, so a typo'd knob silently did
+nothing and a new knob shipped undocumented.  This registry is the
+machine-checked fix:
+
+* the source lint (:mod:`.source_lint`, rule S3) fails on any
+  ``os.environ`` read whose key is not registered here (or in
+  :data:`EXTERNAL` — infra vars owned by jax/XLA, not us);
+* the README "Configuration knobs" table is GENERATED from this file
+  (``python -m librabft_simulator_tpu.audit.knobs --write-readme``;
+  ``--check`` verifies sync, and the audit runs the check), so docs
+  cannot drift from code.
+
+To add a knob: read it in code, add a :class:`Knob` row here, regenerate
+the README table.  The lint makes all three happen or none.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str     # the env var
+    group: str    # "engine" | "bench" | "fuzz" | "script"
+    where: str    # module that reads it
+    values: str   # accepted values / type, human-readable
+    desc: str     # one line
+
+
+KNOBS: tuple[Knob, ...] = (
+    # --- engine lowering / debug (read inside the package) ---------------
+    Knob("LIBRABFT_WRITE_MODE", "engine", "utils/xops.py",
+         "scatter|dense",
+         "A/B override for the queue-write lowering form "
+         "(SimParams.dense_writes='auto' resolves TPU->dense)."),
+    Knob("LIBRABFT_PACKED", "engine", "utils/xops.py", "0|1",
+         "A/B override for the packed [N,S] node-state planes "
+         "(SimParams.packed=None resolves TPU->on)."),
+    Knob("LIBRABFT_GATE_HANDLERS", "engine", "utils/xops.py", "0|1",
+         "A/B override for lax.cond handler gating "
+         "(SimParams.gate_handlers=None resolves TPU->on)."),
+    Knob("LIBRABFT_CHECKIFY", "engine", "audit/sanitize.py", "0|1",
+         "Debug: run_to_completion runs the checkify-instrumented chunk "
+         "(state-invariant + div checks) and raises on the first trip; "
+         "off (default) leaves the engine graphs untouched.  Mutually "
+         "exclusive with stream= (the stream loop is unchecked — "
+         "run_to_completion refuses the combination)."),
+    # --- bench.py -------------------------------------------------------
+    Knob("BENCH_PLATFORM", "bench", "bench.py", "cpu|tpu",
+         "Force the bench backend (skips the tunnel probe)."),
+    Knob("BENCH_SUPERVISED", "bench", "bench.py", "1",
+         "Internal: set in the watchdog-supervised child."),
+    Knob("BENCH_ATTACH_MARKER", "bench", "bench.py", "path",
+         "Internal: attach-progress marker file for the supervisor."),
+    Knob("BENCH_INIT_TIMEOUT", "bench", "bench.py", "seconds",
+         "Backend-attach watchdog budget (default 600)."),
+    Knob("BENCH_PROBE_DIAG", "bench", "bench.py", "text",
+         "Internal: tunnel-probe diagnosis carried into the child."),
+    Knob("BENCH_TUNNEL_PORTS", "bench", "bench.py", "p1,p2,...",
+         "TPU tunnel relay ports to probe (default 8082,8083,8087)."),
+    Knob("BENCH_B", "bench", "bench.py", "int",
+         "Headline bench batch size (default 2048)."),
+    Knob("BENCH_STEPS", "bench", "bench.py", "int",
+         "Events per timed dispatch (default 32; sweeps 64/16)."),
+    Knob("BENCH_REPS", "bench", "bench.py", "int",
+         "Timed repetitions per config."),
+    Knob("BENCH_NODES", "bench", "bench.py", "int",
+         "Nodes per instance (default 4)."),
+    Knob("BENCH_ENGINE", "bench", "bench.py", "serial|parallel|both",
+         "Which engine(s) the headline bench times."),
+    Knob("BENCH_SELECT", "bench", "bench.py", "xla|pallas",
+         "Event-selection kernel for the serial engine."),
+    Knob("BENCH_TELEMETRY", "bench", "bench.py", "1",
+         "Attach the decoded telemetry block to the contract line."),
+    Knob("BENCH_SWEEP", "bench", "bench.py", "1",
+         "Run the 5-config BASELINE sweep instead of the headline."),
+    Knob("BENCH_SWEEP_SCALE", "bench", "bench.py", "float",
+         "Sweep instance-count scale (default 1.0 on TPU, 0.1 host)."),
+    Knob("BENCH_SWEEP_ONLY", "bench", "bench.py", "1-based index",
+         "Run a single sweep config (warm_cache children use this)."),
+    Knob("BENCH_SWEEP_OUT", "bench", "bench.py", "path",
+         "Sweep artifact path (default BENCH_SWEEP.json)."),
+    Knob("BENCH_FLEET", "bench", "bench.py", "1",
+         "Run the dp-ladder fleet bench (one subprocess per rung)."),
+    Knob("BENCH_FLEET_CHILD", "bench", "bench.py", "dp",
+         "Internal: marks a fleet-ladder rung child."),
+    Knob("BENCH_FLEET_ENGINE", "bench", "bench.py", "serial|parallel",
+         "Fleet-ladder engine (default serial)."),
+    Knob("BENCH_FLEET_B", "bench", "bench.py", "int",
+         "Per-shard instances per rung (default 256)."),
+    Knob("BENCH_FLEET_STEPS", "bench", "bench.py", "int",
+         "Events per chunk per rung (default 16)."),
+    Knob("BENCH_FLEET_REPS", "bench", "bench.py", "int",
+         "Timed chunk repetitions per rung (default 2)."),
+    Knob("BENCH_FLEET_DP", "bench", "bench.py", "d1,d2,...",
+         "Ladder rungs (default 1,2,4,8)."),
+    Knob("BENCH_FLEET_OUT", "bench", "bench.py", "path",
+         "Fleet-ladder artifact path."),
+    Knob("BENCH_STREAM", "bench", "bench.py", "1",
+         "Stream per-chunk digests during the fleet ladder (NDJSON + "
+         "FLEET_TIMELINE artifact)."),
+    Knob("BENCH_STREAM_OUT", "bench", "bench.py", "path",
+         "NDJSON timeline path for BENCH_STREAM."),
+    Knob("BENCH_WATCHDOG", "bench", "bench.py", "1",
+         "Arm the consensus watchdog in the fleet ladder."),
+    # --- fuzz -----------------------------------------------------------
+    Knob("FUZZ_PACKED", "fuzz", "scripts/fuzz_parity.py", "0|1",
+         "Run every fuzz trial on the packed-plane engine."),
+    # --- script-local ---------------------------------------------------
+    Knob("LADDER_UNROLL", "script", "scripts/tpu_ladder.py", "0|1",
+         "Census/ladder the unrolled-scan variant."),
+    Knob("LADDER_CHUNK", "script", "scripts/tpu_ladder.py", "int",
+         "Events per timed dispatch (default 64)."),
+    Knob("LADDER_REPS", "script", "scripts/tpu_ladder.py", "int",
+         "Timed repetitions (default 2)."),
+    Knob("XPLAT_NODES", "script", "scripts/xplat_parity.py", "int",
+         "Cross-platform parity config: nodes."),
+    Knob("XPLAT_DELAY", "script", "scripts/xplat_parity.py", "kind",
+         "Cross-platform parity config: delay kind."),
+    Knob("XPLAT_DROP", "script", "scripts/xplat_parity.py", "float",
+         "Cross-platform parity config: drop probability."),
+    Knob("XPLAT_CHAIN", "script", "scripts/xplat_parity.py", "2|3",
+         "Cross-platform parity config: commit chain."),
+    Knob("AB_B", "script", "scripts/scatter_ab.py", "int",
+         "Scatter-vs-dense A/B batch size."),
+    Knob("AB_ITERS", "script", "scripts/scatter_ab.py", "int",
+         "Scatter-vs-dense A/B iterations."),
+    Knob("PN", "script", "scripts/component_profile.py", "int",
+         "Component profile: nodes."),
+    Knob("PB", "script", "scripts/component_profile.py", "int",
+         "Component profile: batch."),
+    Knob("PREPS", "script", "scripts/component_profile.py", "int",
+         "Component profile: repetitions."),
+    Knob("PHO", "script", "scripts/component_profile.py", "0|1",
+         "Component profile: epoch handoff on."),
+)
+
+REGISTERED = frozenset(k.name for k in KNOBS)
+
+#: Infra variables owned by jax/XLA/the tunnel stack — read, never defined,
+#: by this repo; exempt from registration (but still resolved by the lint).
+EXTERNAL = frozenset({"JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS"})
+
+_GROUP_TITLES = (
+    ("engine", "Engine lowering & debug"),
+    ("bench", "bench.py"),
+    ("fuzz", "Fuzzing"),
+    ("script", "Script-local"),
+)
+
+BEGIN_MARK = "<!-- knobs:begin (generated by audit/knobs.py; do not edit) -->"
+END_MARK = "<!-- knobs:end -->"
+
+
+def readme_table() -> str:
+    """The generated README block (between the knob markers)."""
+    lines = [BEGIN_MARK, ""]
+    for group, title in _GROUP_TITLES:
+        rows = [k for k in KNOBS if k.group == group]
+        if not rows:
+            continue
+        lines += [f"**{title}**", "",
+                  "| Knob | Values | Read by | Effect |",
+                  "|---|---|---|---|"]
+        for k in rows:
+            lines.append(
+                f"| `{k.name}` | `{k.values}` | `{k.where}` | {k.desc} |")
+        lines.append("")
+    lines.append(END_MARK)
+    return "\n".join(lines)
+
+
+def _split_readme(text: str) -> tuple[str, str, str]:
+    if BEGIN_MARK not in text or END_MARK not in text:
+        raise ValueError(
+            "README has no knob-table markers; add the "
+            f"'{BEGIN_MARK}' / '{END_MARK}' pair under a 'Configuration "
+            "knobs' heading first")
+    head, rest = text.split(BEGIN_MARK, 1)
+    _, tail = rest.split(END_MARK, 1)
+    return head, text[len(head):len(text) - len(tail)], tail
+
+
+def readme_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "README.md")
+
+
+def readme_in_sync(path: str | None = None) -> bool:
+    with open(path or readme_path()) as f:
+        _, current, _ = _split_readme(f.read())
+    return current == readme_table()
+
+
+def write_readme(path: str | None = None) -> None:
+    path = path or readme_path()
+    with open(path) as f:
+        head, _, tail = _split_readme(f.read())
+    with open(path, "w") as f:
+        f.write(head + readme_table() + tail)
+
+
+def main(argv) -> int:
+    if "--write-readme" in argv:
+        write_readme()
+        print(f"wrote knob table ({len(KNOBS)} knobs) into README.md")
+        return 0
+    if "--check" in argv:
+        ok = readme_in_sync()
+        print("README knob table " + ("in sync" if ok else
+              "STALE — run python -m librabft_simulator_tpu.audit.knobs "
+              "--write-readme"))
+        return 0 if ok else 1
+    # Default: print the table (for piping / review).
+    print(readme_table())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

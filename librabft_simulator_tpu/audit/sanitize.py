@@ -1,0 +1,166 @@
+"""Checkify sanitizer: a runtime-checked build of both engines' chunk
+runners, behind the ``LIBRABFT_CHECKIFY`` knob (default OFF).
+
+The graph auditor proves structural invariants; this module checks the
+*value-level* ones at runtime, in a separately-compiled debug build —
+the engine graphs themselves are untouched (off is trivially bit- and
+kernel-identical: nothing in the hot path even imports this at trace
+time, and the kernel-census CI gates pin the compiled graphs).
+
+What it checks, per chunk:
+
+* **division checks** (``checkify.div_checks``) anywhere in the step;
+* **index-bounds preconditions**: every in-step gather is in bounds iff
+  the state invariants below hold between chunks, so the sanitizer
+  asserts them on the chunk output — queue/inbox ``receiver`` and
+  ``kind`` in range wherever valid, rounds >= 1, commit log consistency
+  (``commit_count + skipped == last_depth``, the Context invariant);
+  NOTE ``checkify.index_checks`` itself is deliberately NOT enabled:
+  the engines' sentinel-drop writes (queue overflow routes to index ==
+  capacity, dropped by ``mode="drop"``) are *intentional* out-of-bounds
+  indices, so a blanket OOB sanitizer flags the design, not bugs;
+* **int-overflow sentinels**: the monotone int32 counters (events,
+  stamps, messages, commits) must stay non-negative — a wrapped counter
+  shows up negative long before it corrupts downstream arithmetic — and
+  the clock must stay inside ``[0, NEVER]``.
+
+Wiring: ``run_to_completion`` in both engines consults :func:`enabled`
+and swaps its chunk runner for :func:`make_checked_run_fn`'s, throwing
+on the first tripped check (``scripts/graph_audit.py --sanitize`` and
+tests/test_audit.py drive it at the warmed micro shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import NEVER, KIND_RESPONSE, SimParams
+from ..utils import xops
+
+CHECKIFY_ENV = "LIBRABFT_CHECKIFY"
+
+
+def enabled() -> bool:
+    """The static debug flag: strict-parsed ``LIBRABFT_CHECKIFY`` env."""
+    return xops._bool_env(CHECKIFY_ENV) or False
+
+
+def _chk():
+    from jax.experimental import checkify
+    return checkify
+
+
+def check_state_invariants(p: SimParams, st) -> None:
+    """``checkify.check`` every cross-chunk state invariant (both engine
+    state flavors; fields are probed by name so one checker serves
+    SimState and PSimState).  Must be called under a checkify trace."""
+    checkify = _chk()
+    n = p.n_nodes
+
+    def all_(x):
+        return jnp.all(jnp.asarray(x))
+
+    # Monotone counters: int32 wrap shows up negative first.
+    for field in ("n_events", "n_msgs_sent", "n_msgs_dropped",
+                  "n_queue_full", "n_inbox_full", "stamp_ctr", "node_ctr",
+                  "trace_count"):
+        if hasattr(st, field):
+            checkify.check(all_(getattr(st, field) >= 0),
+                           f"int32 overflow: {field} wrapped negative")
+    checkify.check(all_((st.clock >= 0) & (st.clock <= NEVER)),
+                   "clock left [0, NEVER]")
+    # Gather preconditions: the next chunk indexes node state by queue
+    # receiver and payload bank by kind — both must be in range wherever
+    # a slot is valid (sentinel-drop writes only ever DROP, so a bad
+    # value here means a write invariant broke).
+    if hasattr(st, "queue"):
+        q = st.queue
+        ok_recv = ~q.valid | ((q.receiver >= 0) & (q.receiver < n))
+        ok_kind = ~q.valid | ((q.kind >= 0) & (q.kind <= KIND_RESPONSE))
+        ok_time = ~q.valid | (q.time >= 0)
+        checkify.check(all_(ok_recv), "queue receiver out of [0, n)")
+        checkify.check(all_(ok_kind), "queue kind out of range")
+        checkify.check(all_(ok_time), "queued event at negative time")
+    if hasattr(st, "in_valid"):
+        ok_kind = ~st.in_valid | ((st.in_kind >= 0)
+                                  & (st.in_kind <= KIND_RESPONSE))
+        ok_send = ~st.in_valid | ((st.in_sender >= 0)
+                                  & (st.in_sender < n))
+        checkify.check(all_(ok_kind), "inbox kind out of range")
+        checkify.check(all_(ok_send), "inbox sender out of [0, n)")
+    # Protocol-state bounds.
+    checkify.check(all_(st.store.current_round >= 1),
+                   "store round below 1 (rounds start at 1)")
+    checkify.check(all_(st.ctx.commit_count >= 0),
+                   "int32 overflow: commit_count wrapped negative")
+    # The Context ledger invariant (core/types.py): every depth is either
+    # delivered or accounted as skipped.
+    checkify.check(
+        all_(st.ctx.commit_count + st.ctx.skipped_commits
+             == st.ctx.last_depth),
+        "commit ledger inconsistent: commit_count + skipped != depth")
+    checkify.check(all_(st.timer_time >= 0), "timer at negative time")
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_checked_run(p_structural: SimParams, num_steps: int,
+                        batched: bool, engine_name: str):
+    checkify = _chk()
+    from ..sim import parallel_sim, simulator
+    eng = parallel_sim if engine_name == "parallel" else simulator
+    scan = eng.make_scan_fn(p_structural, num_steps, batched=batched)
+
+    def checked(st):
+        # Both chunk-boundary states are validated: the INPUT check
+        # catches corrupt externally-supplied states (checkpoint
+        # restores, doctored fixtures) before the scan consumes them —
+        # in-chunk transients are the oracle/fuzz harness's job.
+        check_state_invariants(p_structural, st)
+        st = scan(st)
+        check_state_invariants(p_structural, st)
+        return st
+
+    errors = checkify.user_checks | checkify.div_checks
+    return jax.jit(checkify.checkify(checked, errors=errors))
+
+
+def make_checked_run_fn(p: SimParams, num_steps: int, batched: bool = True,
+                        engine=None):
+    """``st -> (error, st)``: the engine's chunk scan under checkify.
+    Values are bit-identical to the unchecked scan (checkify only adds
+    error plumbing); compile is separate — warm it via
+    ``scripts/warm_cache.py`` (the sanitizer children) before tier-1."""
+    from ..sim import parallel_sim
+    p = xops.resolve_params(p)
+    name = "parallel" if engine is parallel_sim else "serial"
+    # Memoized like the engines' _compiled_run: note the structural()
+    # projection would drop the delay table the scan closure bakes in, so
+    # the cache key keeps the full resolved params.
+    return _cached_checked_run(p, num_steps, batched, name)
+
+
+def run_checked(p: SimParams, st, num_steps: int, batched: bool = True,
+                engine=None):
+    """One checked chunk; raises ``checkify.JaxRuntimeError`` on the first
+    tripped invariant, else returns the post-chunk state."""
+    err, out = make_checked_run_fn(p, num_steps, batched=batched,
+                                   engine=engine)(st)
+    err.throw()
+    return out
+
+
+def checked_completion(p: SimParams, st, chunk: int, max_chunks: int,
+                       batched: bool, engine):
+    """The ``run_to_completion`` drop-in both engines use when
+    :func:`enabled` — same halt loop, every chunk checked."""
+    import numpy as np
+    run = make_checked_run_fn(p, chunk, batched=batched, engine=engine)
+    for _ in range(max_chunks):
+        err, st = run(st)
+        err.throw()
+        if bool(np.all(jax.device_get(st.halted))):
+            break
+    return st

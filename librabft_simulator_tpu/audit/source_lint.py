@@ -1,0 +1,489 @@
+"""AST source lint: the invariants the jaxpr auditor cannot see.
+
+The graph auditor (:mod:`.graph_lint`) checks what a trace *produced*;
+these rules check what the source *could* produce on a path the audit
+shapes didn't take, plus repo-hygiene rules that live outside any trace.
+Pure-AST, no jax import — the whole pass is milliseconds.
+
+Rules
+-----
+
+S1  **Host libraries in traced code.**  ``np.*`` / ``math.*`` calls inside
+    the engines' traced functions silently materialize tracers (or crash
+    at a shape nobody traced); only static shape arithmetic
+    (:data:`ALLOWED_NP`) is exempt.  Scope: :data:`S1_SCOPE` modules minus
+    their registered host-side functions (:data:`HOST_FUNCTIONS` /
+    :data:`HOST_CLASSES`).  S1b: in the step functions proper
+    (:data:`STEP_TRACER_ARGS`), an ``if``/``while`` test may not
+    reference a tracer argument (Python control flow on a tracer is a
+    trace-time crash at best, a silently-specialized graph at worst) —
+    branch on ``SimParams`` fields, which are static.
+S2  **Host syncs in hot-loop modules.**  ``jax.device_get`` /
+    ``block_until_ready`` stall the dispatch pipeline; inside the
+    hot-loop modules (engines, parallel runtime, in-graph telemetry)
+    every occurrence must be a registered sanctioned site
+    (:data:`SANCTIONED_SYNCS`) — the fleet runtime's whole design is ONE
+    digest fetch per chunk (tests/test_multichip.py pins it dynamically;
+    this rule pins it at review time).  Post-run decode modules
+    (analysis/, telemetry/report.py, checkpoint.py, ...) fetch to host by
+    design and are out of scope.
+S3  **Unregistered env knobs.**  Every ``os.environ`` read must use a key
+    registered in :mod:`.knobs` (or an :data:`knobs.EXTERNAL` infra var).
+    Keys are resolved through module-level constants and the registered
+    reader helpers, so ``os.environ.get(MODE_ENV)`` resolves fine; an
+    unresolvable key is itself a finding.
+S4  **Budget literals outside scripts/budgets.py.**  The CI census/audit
+    budgets are single-sourced in ``scripts/budgets.py``; a budget value
+    reappearing as a literal on a budget-ish line in ``scripts/*.py`` or
+    as an inline ``${VAR:-N}`` default in ``scripts/ci_tier1.sh`` is the
+    drift this satellite existed to kill.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from . import knobs as knobs_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    flavor: str      # always "source" (mirrors graph_lint.Finding)
+    severity: str    # "error"
+    summary: str
+    site: str = ""   # "relpath:line"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+PACKAGE = "librabft_simulator_tpu"
+
+# ---------------------------------------------------------------------------
+# S1 scope + registries.
+# ---------------------------------------------------------------------------
+
+#: Modules whose function bodies are (mostly) traced.  Relative to the
+#: package root.
+S1_SCOPE = (
+    "core/store.py", "core/node.py", "core/data_sync.py",
+    "core/pacemaker.py", "core/config.py", "core/packing.py",
+    "core/types.py",
+    "sim/simulator.py", "sim/parallel_sim.py",
+    "telemetry/plane.py", "telemetry/stream.py",
+    "utils/xops.py", "utils/hashing.py",
+)
+
+#: Host-side functions inside S1_SCOPE modules (np/math is their job:
+#: post-run decode, host loops, table precompute, env resolution).
+HOST_FUNCTIONS = {
+    "sim/simulator.py": {"run_to_completion", "stream_completion",
+                         "init_batch"},
+    "sim/parallel_sim.py": {"run_to_completion", "init_batch",
+                            "d_min_of"},  # static lookahead from the
+                                          # host-precomputed delay table
+    "telemetry/plane.py": {"fold_planes", "decode", "np_registry",
+                           "np_width", "ring_order"},
+    "telemetry/stream.py": {"decode_digest", "pad_digest", "fold_digests",
+                            "load_ndjson"},
+    "core/types.py": {"payload_width"},
+    "utils/xops.py": {"backend_mode", "packed_mode", "gate_mode",
+                      "resolve_params", "_bool_env"},
+}
+
+#: Whole classes that are host-side (every method exempt from S1).
+HOST_CLASSES = {
+    "core/types.py": {"SimParams"},
+    "telemetry/stream.py": {"TimelineRecorder"},
+}
+
+#: np attributes that are STATIC shape arithmetic, legal under a trace
+#: (they consume Python ints / .shape tuples, never tracers).
+ALLOWED_NP = {"prod", "int32", "uint32", "dtype"}
+
+#: S1b — the step functions and their tracer argument names: an
+#: ``if``/``while`` test referencing one of these is Python control flow
+#: on a tracer.
+STEP_TRACER_ARGS = {
+    "sim/simulator.py": {
+        "step": {"st", "delay_table", "dur_table"},
+        "_select_event": {"st"},
+        "_equivocated_payload": {"s_a", "pay"},
+        "_forged_qc_payload": {"s_a", "pay"},
+    },
+    "sim/parallel_sim.py": {
+        "step": {"st", "delay_table", "dur_table"},
+        "_earliest": {"in_valid", "in_time", "in_kind", "in_stamp",
+                      "timer_time"},
+        "_equivocate": {"pay"},
+    },
+}
+
+# ---------------------------------------------------------------------------
+# S2 scope + sanctions.
+# ---------------------------------------------------------------------------
+
+
+def _s2_in_scope(rel: str) -> bool:
+    """Hot-loop modules: the engines, the parallel runtime, in-graph
+    telemetry, core protocol, kernels, utils.  Post-run decode modules are
+    host-side by design (analysis/, report.py, checkpoint.py, byzantine
+    referees, main.py, oracle/, realnode/)."""
+    if rel in ("sim/simulator.py", "sim/parallel_sim.py",
+               "telemetry/plane.py", "telemetry/stream.py"):
+        return True
+    return rel.startswith(("core/", "parallel/", "ops/", "utils/"))
+
+
+#: (package-relative file, enclosing function) -> justification.  Every
+#: device_get / block_until_ready inside S2 scope must appear here.
+SANCTIONED_SYNCS = {
+    ("parallel/sharded.py", "_poll_digest"):
+        "THE poll path: the fleet loop's single blocking fetch — one [D] "
+        "digest per chunk (pinned dynamically by test_multichip's "
+        "monkeypatched device_get).",
+    ("parallel/sharded.py", "pad_to_multiple"):
+        "one-time host-side padding of a host (checkpoint-restored) "
+        "fleet: filler is fetched once, outside the chunk loop.",
+    ("sim/simulator.py", "run_to_completion"):
+        "single-chip host completion loop (tests/CLI), not the fleet "
+        "runtime hot path.",
+    ("sim/simulator.py", "stream_completion"):
+        "the digest-contract host loop: one [D] fetch per chunk by "
+        "construction.",
+    ("sim/parallel_sim.py", "run_to_completion"):
+        "single-chip host completion loop (tests/CLI).",
+}
+
+# ---------------------------------------------------------------------------
+# S3 helpers.
+# ---------------------------------------------------------------------------
+
+#: Functions that read os.environ with a key passed by parameter; the lint
+#: checks their CALL SITES' first argument instead of the read inside.
+READER_HELPERS = {"_bool_env"}
+
+
+# ---------------------------------------------------------------------------
+# AST walking.
+# ---------------------------------------------------------------------------
+
+
+def _module_constants(tree: ast.Module) -> dict:
+    """Module-level ``NAME = "literal"`` string assignments (how xops names
+    its env keys)."""
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _attr_chain(node) -> list[str]:
+    """['os', 'environ', 'get'] for os.environ.get — [] if not a chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+class _FuncInfo:
+    def __init__(self, node, classes):
+        self.node = node
+        self.name = node.name
+        self.classes = tuple(classes)  # enclosing class names
+
+
+def _functions(tree) -> list[_FuncInfo]:
+    out = []
+
+    def rec(node, classes):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(_FuncInfo(child, classes))
+                rec(child, classes)
+            elif isinstance(child, ast.ClassDef):
+                rec(child, classes + [child.name])
+            else:
+                rec(child, classes)
+
+    rec(tree, [])
+    return out
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# ---------------------------------------------------------------------------
+# Rules.
+# ---------------------------------------------------------------------------
+
+
+def _s1_host(rel: str, fn: _FuncInfo) -> bool:
+    if fn.name in HOST_FUNCTIONS.get(rel, ()):
+        return True
+    host_classes = HOST_CLASSES.get(rel, ())
+    return any(c in host_classes for c in fn.classes)
+
+
+def lint_s1(rel: str, tree: ast.Module) -> list[Finding]:
+    if rel not in S1_SCOPE:
+        return []
+    findings = []
+    host_spans = []  # line spans of host functions: nested defs inherit
+    for fn in _functions(tree):
+        if _s1_host(rel, fn):
+            host_spans.append((fn.node.lineno, fn.node.end_lineno))
+    for fn in _functions(tree):
+        span_host = any(a <= fn.node.lineno <= b for a, b in host_spans)
+        if span_host:
+            continue
+        for node in ast.walk(fn.node):
+            chain = _attr_chain(node) if isinstance(node, ast.Attribute) \
+                else []
+            if len(chain) >= 2 and chain[0] in ("np", "math") \
+                    and chain[1] not in (ALLOWED_NP
+                                         if chain[0] == "np" else ()):
+                findings.append(Finding(
+                    "S1", "source", "error",
+                    f"{'.'.join(chain)} inside traced function "
+                    f"{fn.name}() — host numerics silently materialize "
+                    "tracers; use jnp (or register the function in "
+                    "HOST_FUNCTIONS with a reason)",
+                    f"{rel}:{node.lineno}"))
+        tracer_args = STEP_TRACER_ARGS.get(rel, {}).get(fn.name)
+        if tracer_args:
+            for node in ast.walk(fn.node):
+                if isinstance(node, (ast.If, ast.While)):
+                    hit = _names_in(node.test) & tracer_args
+                    if hit:
+                        findings.append(Finding(
+                            "S1", "source", "error",
+                            f"Python {type(node).__name__.lower()} on "
+                            f"tracer argument(s) {sorted(hit)} in "
+                            f"{fn.name}() — branch with lax.cond/"
+                            "jnp.where, or on static SimParams fields",
+                            f"{rel}:{node.lineno}"))
+    return findings
+
+
+def lint_s2(rel: str, tree: ast.Module) -> list[Finding]:
+    if not _s2_in_scope(rel):
+        return []
+    findings = []
+    funcs = _functions(tree)
+
+    def enclosing(lineno) -> str:
+        best = "<module>"
+        for fn in funcs:
+            if fn.node.lineno <= lineno <= (fn.node.end_lineno or 0):
+                best = fn.name  # innermost wins (functions walked outer-in)
+        return best
+
+    for node in ast.walk(tree):
+        # Both spellings: jax.device_get / x.block_until_ready
+        # (Attribute) AND `from jax import device_get; device_get(...)`
+        # (bare Name) — the import form must not bypass the rule.
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            name = node.id
+        else:
+            continue
+        if name not in ("device_get", "block_until_ready"):
+            continue
+        func = enclosing(node.lineno)
+        if (rel, func) in SANCTIONED_SYNCS:
+            continue
+        findings.append(Finding(
+            "S2", "source", "error",
+            f"{name} in hot-loop module function {func}() outside "
+            "the sanctioned sites — the fleet contract is one [D] digest "
+            "fetch per chunk (parallel/sharded._poll_digest); add a "
+            "SANCTIONED_SYNCS entry only with a justification",
+            f"{rel}:{node.lineno}"))
+    return findings
+
+
+def _env_reads(tree: ast.Module):
+    """Yield (key_expr, lineno, enclosing_reader_param_names) for every
+    os.environ read in a module."""
+    funcs = _functions(tree)
+
+    def reader_params(lineno):
+        for fn in funcs:
+            if fn.name in READER_HELPERS and \
+                    fn.node.lineno <= lineno <= (fn.node.end_lineno or 0):
+                return {a.arg for a in fn.node.args.args}
+        return set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load) \
+                and _attr_chain(node.value) == ["os", "environ"]:
+            yield node.slice, node.lineno, reader_params(node.lineno)
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain in (["os", "environ", "get"],
+                         ["os", "environ", "setdefault"],
+                         ["os", "getenv"]) and node.args:
+                yield node.args[0], node.lineno, reader_params(node.lineno)
+            elif chain and chain[-1] in READER_HELPERS and node.args:
+                # A registered reader call: its first arg IS the key.
+                yield node.args[0], node.lineno, set()
+
+
+def lint_s3(rel: str, tree: ast.Module) -> list[Finding]:
+    findings = []
+    consts = _module_constants(tree)
+    for key_expr, lineno, reader_params in _env_reads(tree):
+        if isinstance(key_expr, ast.Constant) \
+                and isinstance(key_expr.value, str):
+            key = key_expr.value
+        elif isinstance(key_expr, ast.Name) and key_expr.id in consts:
+            key = consts[key_expr.id]
+        elif isinstance(key_expr, ast.Name) \
+                and key_expr.id in reader_params:
+            continue  # the reader helper itself; call sites are checked
+        else:
+            findings.append(Finding(
+                "S3", "source", "error",
+                "os.environ read with an unresolvable key — name env "
+                "keys with string literals or module-level constants so "
+                "the knob registry stays checkable",
+                f"{rel}:{lineno}"))
+            continue
+        if key in knobs_mod.REGISTERED or key in knobs_mod.EXTERNAL:
+            continue
+        findings.append(Finding(
+            "S3", "source", "error",
+            f"env knob {key!r} is not registered in audit/knobs.py — add "
+            "a Knob row (and regenerate the README table) or drop the "
+            "read",
+            f"{rel}:{lineno}"))
+    return findings
+
+
+def _load_budgets(root: str) -> dict:
+    path = os.path.join(root, "scripts", "budgets.py")
+    ns: dict = {}
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), ns)  # noqa: S102 — our file
+    return ns["BUDGETS"]
+
+
+_BUDGETISH = re.compile(r"(?i)budget|assert|min_dots|floor")
+
+
+def lint_s4(root: str) -> list[Finding]:
+    findings = []
+    try:
+        budgets = _load_budgets(root)
+    except FileNotFoundError:
+        return [Finding("S4", "source", "error",
+                        "scripts/budgets.py missing — the census budgets "
+                        "have no single source", "scripts/budgets.py")]
+    values = set(budgets.values())
+    sdir = os.path.join(root, "scripts")
+    for name in sorted(os.listdir(sdir)):
+        if not name.endswith(".py") or name == "budgets.py":
+            continue
+        path = os.path.join(sdir, name)
+        with open(path) as f:
+            text = f.read()
+        lines = text.splitlines()
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and type(node.value) is int \
+                    and node.value in values \
+                    and _BUDGETISH.search(lines[node.lineno - 1]):
+                findings.append(Finding(
+                    "S4", "source", "error",
+                    f"budget literal {node.value} in scripts/{name} — "
+                    "consume scripts/budgets.py instead of restating the "
+                    "value", f"scripts/{name}:{node.lineno}"))
+    sh = os.path.join(sdir, "ci_tier1.sh")
+    if os.path.exists(sh):
+        with open(sh) as f:
+            for i, line in enumerate(f, 1):
+                if re.search(r"(BUDGET|MIN_DOTS)\w*=", line) \
+                        and re.search(r":-\s*\d|=\s*\d", line):
+                    findings.append(Finding(
+                        "S4", "source", "error",
+                        "inline budget default in ci_tier1.sh — budgets "
+                        "come from `eval \"$(python scripts/budgets.py "
+                        "--sh)\"`", f"scripts/ci_tier1.sh:{i}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry points.
+# ---------------------------------------------------------------------------
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def lint_text(rel: str, text: str) -> list[Finding]:
+    """Lint one file's source (S1-S3).  ``rel`` is the path the scope
+    rules see: package files are package-relative ('sim/simulator.py'),
+    everything else repo-relative ('bench.py', 'scripts/x.py') — exactly
+    what :func:`run` passes.  Fixture tests feed synthetic sources here."""
+    tree = ast.parse(text)
+    return lint_s1(rel, tree) + lint_s2(rel, tree) + lint_s3(rel, tree)
+
+
+def run(root: str | None = None) -> list[Finding]:
+    """Lint the whole repo; returns all findings (S1-S4)."""
+    root = root or repo_root()
+    findings: list[Finding] = []
+    skip_dirs = {"tests", "__pycache__", "native", ".git", ".claude",
+                 "related"}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+        for name in sorted(filenames):
+            if not name.endswith(".py") or name == "__graft_entry__.py":
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel.startswith(PACKAGE + "/"):
+                rel = rel[len(PACKAGE) + 1:]
+            with open(path) as f:
+                try:
+                    findings += lint_text(rel, f.read())
+                except SyntaxError as e:
+                    findings.append(Finding(
+                        "S1", "source", "error",
+                        f"unparseable source: {e}", rel))
+    findings += lint_s4(root)
+    try:
+        in_sync = knobs_mod.readme_in_sync(
+            os.path.join(root, "README.md"))
+    except (ValueError, FileNotFoundError) as e:
+        in_sync = False
+        findings.append(Finding(
+            "S3", "source", "error", str(e), "README.md"))
+    if not in_sync:
+        findings.append(Finding(
+            "S3", "source", "error",
+            "README 'Configuration knobs' table is stale — run "
+            "python -m librabft_simulator_tpu.audit.knobs --write-readme",
+            "README.md"))
+    return findings

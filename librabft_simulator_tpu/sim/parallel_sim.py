@@ -951,11 +951,24 @@ def run_to_completion(p: SimParams, st: PSimState, chunk: int = RUN_CHUNK,
     from .simulator import dedupe_buffers, stream_completion
 
     st = dedupe_buffers(st)
+    from ..audit import sanitize
     if stream is not None:
+        if sanitize.enabled():
+            # See simulator.run_to_completion: never pretend the stream
+            # loop was invariant-checked.
+            raise ValueError(
+                "LIBRABFT_CHECKIFY=1 and stream= are mutually exclusive: "
+                "the digest stream loop runs the unchecked chunk; unset "
+                "the knob or drop the recorder")
         # Digest poll contract (see simulator.stream_completion).
         return stream_completion(
             make_run_fn(p, chunk, batched=batched, digest=True), st,
             chunk, max_chunks, batched, stream)
+    if sanitize.enabled():
+        # LIBRABFT_CHECKIFY debug build — see simulator.run_to_completion.
+        import sys as _sys
+        return sanitize.checked_completion(
+            p, st, chunk, max_chunks, batched, _sys.modules[__name__])
     run = make_run_fn(p, chunk, batched=batched)
     for _ in range(max_chunks):
         st = run(st)

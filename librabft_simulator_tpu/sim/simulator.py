@@ -720,10 +720,26 @@ def run_to_completion(p: SimParams, st: SimState, chunk: int = RUN_CHUNK,
     digest vector instead of the halted plane, and the recorder receives
     every digest — the single-chip flavor of run_sharded's live stream."""
     st = dedupe_buffers(st)
+    from ..audit import sanitize
     if stream is not None:
+        if sanitize.enabled():
+            # Silently running the UNchecked stream loop under
+            # LIBRABFT_CHECKIFY would let an operator conclude a state
+            # passed invariants that were never evaluated — refuse loud.
+            raise ValueError(
+                "LIBRABFT_CHECKIFY=1 and stream= are mutually exclusive: "
+                "the digest stream loop runs the unchecked chunk; unset "
+                "the knob or drop the recorder")
         return stream_completion(
             make_run_fn(p, chunk, batched=batched, digest=True), st,
             chunk, max_chunks, batched, stream)
+    if sanitize.enabled():
+        # LIBRABFT_CHECKIFY: run the checkify-instrumented debug build
+        # (audit/sanitize.py) — bit-identical values, raises on the first
+        # tripped state invariant.  Off (default) never reaches here.
+        import sys as _sys
+        return sanitize.checked_completion(
+            p, st, chunk, max_chunks, batched, _sys.modules[__name__])
     run = make_run_fn(p, chunk, batched=batched)
     for _ in range(max_chunks):
         st = run(st)

@@ -1,0 +1,73 @@
+"""The CI census/audit budgets — single source of truth.
+
+Every ``--assert-*`` regression gate reads its budget from here: the four
+kernel-census fusion budgets and the tier-1 dot floor used to live as env
+defaults in ``scripts/ci_tier1.sh`` AND as numbers restated in comments and
+flag help — drift between the copies was only a matter of time.  Now:
+
+* ``scripts/ci_tier1.sh`` materializes them with
+  ``eval "$(python scripts/budgets.py --sh)"`` (caller-exported overrides
+  win — the emitted lines use ``${VAR:-default}``);
+* ``scripts/kernel_census.py --assert-budgets`` applies all four census
+  budgets directly;
+* the source lint (audit/source_lint.py rule S4) flags any budget value
+  reappearing as a literal on a budget-ish line elsewhere in scripts/.
+
+Provenance of the values:
+
+* ``census_off`` 220       — tpu_shape top fusions 205 (KERNEL_CENSUS_r06,
+  n=4/B=2048 CPU-lowering proxy) + ~7% headroom.
+* ``census_telemetry`` 230 — tpu_shape_telemetry 214 (KERNEL_CENSUS_r07:
+  +9 fusions for plane + flight recorder) + the same headroom.
+* ``census_watchdog`` 220  — the watchdog measured ZERO top-level fusion
+  cost (KERNEL_CENSUS_r09: 205 == off), so its ON budget IS the off
+  budget: a regression that makes disabled-quality detectors cost kernels
+  fails even if the off graph stays clean.
+* ``census_sharded`` 238   — per-shard program 222-226 (205 + scan/pack/
+  halt-digest overhead; KERNEL_CENSUS_r09) + headroom.
+* ``tier1_min_dots`` 39    — the seed suite's dot count at the 870 s
+  timeout; PR baselines since run 49-59 (see CHANGES.md).
+
+Usage:
+    python scripts/budgets.py            # print the table
+    python scripts/budgets.py --sh       # shell-eval'able defaults
+    python scripts/budgets.py --json     # machine-readable
+"""
+
+import json
+import sys
+
+BUDGETS = {
+    "census_off": 220,
+    "census_telemetry": 230,
+    "census_watchdog": 220,
+    "census_sharded": 238,
+    "tier1_min_dots": 39,
+}
+
+#: The shell variable each budget materializes as (ci_tier1.sh contract).
+SH_VARS = {
+    "census_off": "CENSUS_BUDGET",
+    "census_telemetry": "TELEMETRY_CENSUS_BUDGET",
+    "census_watchdog": "WATCHDOG_CENSUS_BUDGET",
+    "census_sharded": "SHARDED_CENSUS_BUDGET",
+    "tier1_min_dots": "TIER1_MIN_DOTS",
+}
+
+
+def main(argv) -> int:
+    if "--sh" in argv:
+        # ${VAR:-default}: a caller-exported override survives the eval.
+        for key, var in SH_VARS.items():
+            print(f'{var}="${{{var}:-{BUDGETS[key]}}}"')
+        return 0
+    if "--json" in argv:
+        print(json.dumps(BUDGETS))
+        return 0
+    for key, val in BUDGETS.items():
+        print(f"{key:18s} {val:4d}  (${SH_VARS[key]})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

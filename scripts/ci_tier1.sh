@@ -1,19 +1,17 @@
 #!/usr/bin/env bash
-# Tier-1 CI gate: the exact verify command from ROADMAP.md, plus the
-# compile-time kernel-census regression check from PR 1.
+# Tier-1 CI gate: static audit -> the exact verify command from ROADMAP.md
+# -> explicit referee tests -> the compile-time kernel-census gates.
 #
-# The census budget is the tpu_shape top-level fusion count recorded in
-# KERNEL_CENSUS_r06.json (205 at n=4/B=2048, CPU-lowering proxy) plus
-# ~7% headroom; a PR that pushes the serial step's kernel count back
-# above it fails here without needing the TPU tunnel.  The telemetry-on
-# graph (SimParams.telemetry) gets its own budget from the
-# tpu_shape_telemetry count recorded in KERNEL_CENSUS_r07.json (214 =
-# tpu_shape + 9 fusions for the metrics plane + flight recorder) plus the
-# same headroom — telemetry OFF must stay inside the original budget
-# (observability must cost zero kernels when disabled), telemetry ON must
-# stay bounded.  The round-9 consensus watchdog gets the OFF budget as its
-# ON budget (it measured zero top-level fusion cost — see
-# KERNEL_CENSUS_r09.json and PERF_NOTES round 9).
+# Ordering rationale: the graph/source audit (scripts/graph_audit.py)
+# TRACES both engines' graphs — no XLA compile — so it catches a
+# miscompile-class scatter, a float leak, a smuggled callback, an
+# unregistered knob, or a budget literal in ~2 minutes, before the suite
+# spends its 870 s compile budget and long before the census compiles.
+#
+# All numeric budgets are single-sourced in scripts/budgets.py (the eval
+# below materializes them; caller-exported overrides win).  Provenance of
+# each value is documented there, and the source lint (audit rule S4)
+# fails this file if a literal default ever reappears here.
 #
 # The 870 s pytest timeout is EXPECTED on this container (the suite is
 # XLA-compile-bound: the PR-1 baseline is DOTS_PASSED=49 at the timeout
@@ -25,16 +23,7 @@
 set -u
 cd "$(dirname "$0")/.."
 
-CENSUS_BUDGET=${CENSUS_BUDGET:-220}
-TELEMETRY_CENSUS_BUDGET=${TELEMETRY_CENSUS_BUDGET:-230}
-SHARDED_CENSUS_BUDGET=${SHARDED_CENSUS_BUDGET:-238}
-# The consensus watchdog (telemetry/stream.py) measured ZERO top-level
-# fusion cost at the bench shape (tpu_shape_watchdog == tpu_shape == 205,
-# KERNEL_CENSUS_r09.json — the detectors fuse into existing kernels), so
-# its budget equals the off budget: a regression that makes the watchdog
-# cost kernels fails here even if the off graph stays clean.
-WATCHDOG_CENSUS_BUDGET=${WATCHDOG_CENSUS_BUDGET:-220}
-TIER1_MIN_DOTS=${TIER1_MIN_DOTS:-39}
+eval "$(python scripts/budgets.py --sh)"
 
 echo "=== collection check ==="
 # Collection errors are invisible in the timeout pass-path below (pytest
@@ -44,6 +33,15 @@ echo "=== collection check ==="
 if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/ \
     --collect-only -q -m 'not slow' -p no:cacheprovider >/dev/null 2>&1; then
     echo "FAIL: test collection errors (run pytest --collect-only)" >&2
+    exit 1
+fi
+
+echo "=== static graph + source audit (audit/: jaxpr rules R1-R6, source lint S1-S4) ==="
+# Fail fast: audit traces are minutes of pure Python, cheaper than any
+# XLA compile below.  Emits the machine-readable artifact either way.
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
+    --assert-clean --out GRAPH_AUDIT_r10.json; then
+    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r10.json)" >&2
     exit 1
 fi
 
@@ -58,15 +56,17 @@ dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 fails=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd FE | wc -c)
 echo "DOTS_PASSED=${dots} FAILS=${fails} rc=${rc}"
 
-echo "=== 2-shard dp fleet parity + stream referees (explicit; the 870 s suite may time out before reaching them) ==="
+echo "=== 2-shard dp fleet parity + stream + audit referees (explicit; the 870 s suite may time out before reaching them) ==="
 # The fleet runtime's tier-1 referees: 2-shard parity for both engines at
 # an odd batch, padding telemetry/oracle pinning, the one-[D]-digest-per-
-# chunk halt-poll assertion, and the stream/watchdog oracle pins
-# (tests/test_stream.py).  Runs from the persistent compile cache the
+# chunk halt-poll assertion, the stream/watchdog oracle pins
+# (tests/test_stream.py), and the auditor's own referees — seeded-
+# violation fixtures + engines-pass-clean + the checkify sanitizer smoke
+# (tests/test_audit.py).  Runs from the persistent compile cache the
 # suite pass above already populated.
-timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_multichip.py tests/test_stream.py -q -m 'not slow' \
-    -p no:cacheprovider -p no:xdist -p no:randomly
+timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_multichip.py tests/test_stream.py tests/test_audit.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 parity_rc=$?
 
 echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard) ==="
@@ -92,7 +92,7 @@ if [ "$tests_ok" -ne 0 ]; then
     exit 1
 fi
 if [ "$parity_rc" -ne 0 ]; then
-    echo "FAIL: 2-shard dp fleet parity rc=$parity_rc" >&2
+    echo "FAIL: fleet parity / stream / audit referees rc=$parity_rc" >&2
     exit 1
 fi
 if [ "$census_rc" -ne 0 ]; then

@@ -1,0 +1,145 @@
+"""Run every static-analysis pass and gate CI on a clean result.
+
+Passes (librabft_simulator_tpu/audit/):
+
+1. **Graph lint** — traces both engines' step functions in every lowering
+   flavor (cpu_default, tpu_shape, telemetry/watchdog twins, the
+   dp-sharded runner) and enforces jaxpr rules R1-R6 (graph_lint.py).
+   Tracing never compiles, so the whole matrix costs ~2 minutes, vs the
+   census's XLA compiles — which is why CI runs this FIRST.
+2. **Source lint** — AST rules S1-S4 (host libs in traced code,
+   unsanctioned host syncs, unregistered env knobs, duplicated budget
+   literals) + the README knob-table sync check (source_lint.py).
+3. **Sanitizer smoke** (``--sanitize``) — compiles and runs the
+   checkify-instrumented chunk of both engines at the warmed micro fleet
+   shapes; any tripped state invariant fails.  Off by default (it
+   compiles); scripts/warm_cache.py runs it to pre-warm the debug
+   executables, and tests/test_audit.py smokes it in tier-1.
+
+Output: a GRAPH_AUDIT artifact (rule -> status -> offending eqn/source
+site) via ``--out``; ``--assert-clean`` exits nonzero on any error-grade
+finding (waived findings are recorded but pass).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/graph_audit.py --assert-clean
+    python scripts/graph_audit.py --shape micro --sanitize
+    python scripts/graph_audit.py --out GRAPH_AUDIT_r10.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The sharded-runner rules (R5, R6/mp) trace a 2-shard mesh: force virtual
+# devices BEFORE backend init (same shim as kernel_census --sharded).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def run_sanitize_smoke() -> list:
+    """Compile + run the checked chunk of both engines at the warmed micro
+    fleet shapes; returns error findings (graph_lint.Finding-shaped)."""
+    import numpy as np
+
+    from librabft_simulator_tpu.audit import sanitize
+    from librabft_simulator_tpu.audit.graph_lint import Finding
+    from librabft_simulator_tpu.core.types import SimParams
+    from librabft_simulator_tpu.sim import parallel_sim, simulator
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tests"))
+    from fleet_shapes import FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, \
+        FLEET_SER_KW
+
+    findings = []
+    for name, eng, kw in (("serial", simulator, FLEET_SER_KW),
+                          ("parallel", parallel_sim, FLEET_LANE_KW)):
+        p = SimParams(max_clock=500, **kw)
+        st = eng.init_batch(p, np.arange(FLEET_B, dtype=np.uint32))
+        try:
+            sanitize.run_checked(p, st, FLEET_CHUNK, batched=True,
+                                 engine=eng)
+        except Exception as e:  # noqa: BLE001 — any trip/compile failure
+            findings.append(Finding(
+                "SAN", f"sanitize/{name}", "error",
+                f"checkify sanitizer tripped or failed on the {name} "
+                f"engine micro chunk: {type(e).__name__}: "
+                f"{str(e)[:200]}", ""))
+    return findings
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--shape", choices=("census", "micro"),
+                    default="census",
+                    help="audit shape: the kernel-census shape (CI "
+                         "default) or the micro fleet shape (fast)")
+    ap.add_argument("--engines", default="serial,lane",
+                    help="comma list of engines to graph-audit")
+    ap.add_argument("--no-sharded", action="store_true",
+                    help="skip the sharded-runner rules (R5, R6/mp)")
+    ap.add_argument("--no-source", action="store_true",
+                    help="skip the AST source lint")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="also compile+run the checkify sanitizer smoke "
+                         "at the micro fleet shapes")
+    ap.add_argument("--out", default=None,
+                    help="write the GRAPH_AUDIT JSON artifact here")
+    ap.add_argument("--assert-clean", action="store_true",
+                    help="exit nonzero on any error-grade finding")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    from librabft_simulator_tpu.audit import graph_lint, source_lint
+
+    out = graph_lint.audit_all(
+        shape=args.shape,
+        engines=tuple(e for e in args.engines.split(",") if e),
+        sharded=not args.no_sharded)
+    out["graph_seconds"] = round(time.time() - t0, 1)
+
+    if not args.no_source:
+        src = source_lint.run()
+        out["findings"] += [f.to_json() for f in src]
+        out["source_findings"] = len(src)
+    if args.sanitize:
+        san = run_sanitize_smoke()
+        out["findings"] += [f.to_json() for f in san]
+        out["sanitize"] = "fail" if san else "ok"
+
+    errors = [f for f in out["findings"] if f["severity"] == "error"]
+    waived = [f for f in out["findings"] if f["severity"] == "waived"]
+    out["n_errors"], out["clean"] = len(errors), not errors
+    out["elapsed_seconds"] = round(time.time() - t0, 1)
+
+    for f in out["findings"]:
+        tag = "WAIVED" if f["severity"] == "waived" else "ERROR "
+        site = f" [{f['site']}]" if f["site"] else ""
+        print(f"{tag} {f['rule']:3s} {f['flavor']:24s}"
+              f" {f['summary'][:110]}{site}")
+    print(f"graph audit: {len(errors)} error(s), {len(waived)} waived, "
+          f"{len(out['flavors'])} flavors, {out['elapsed_seconds']}s",
+          flush=True)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}")
+    if args.assert_clean and errors:
+        print("FAIL: graph audit not clean (--assert-clean)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,10 +41,11 @@ import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # budgets
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-if "--sharded" in sys.argv or any(
+if "--sharded" in sys.argv or "--assert-budgets" in sys.argv or any(
         a.startswith("--assert-sharded-max") for a in sys.argv):
     # The sharded census needs virtual devices BEFORE backend init (and
     # --assert-sharded-max implies --sharded, so it must trigger the shim
@@ -214,9 +215,27 @@ def main() -> int:
                     help="exit nonzero if the per-shard tpu_shape fusion "
                          "count exceeds this budget (CI gate; implies "
                          "--sharded)")
+    ap.add_argument("--assert-budgets", action="store_true",
+                    help="apply all four census budgets from "
+                         "scripts/budgets.py (the CI single source) — "
+                         "equivalent to passing each --assert-* flag "
+                         "with its recorded budget")
     ap.add_argument("--out", default=None,
                     help="write the full census JSON here")
     args = ap.parse_args()
+    if args.assert_budgets:
+        # Budgets live in ONE place (scripts/budgets.py); the source lint
+        # flags any literal restated here.
+        import budgets as _budgets
+        b = _budgets.BUDGETS
+        if args.assert_max is None:
+            args.assert_max = b["census_off"]
+        if args.assert_telemetry_max is None:
+            args.assert_telemetry_max = b["census_telemetry"]
+        if args.assert_watchdog_max is None:
+            args.assert_watchdog_max = b["census_watchdog"]
+        if args.assert_sharded_max is None:
+            args.assert_sharded_max = b["census_sharded"]
     if args.assert_sharded_max is not None:
         args.sharded = True
 

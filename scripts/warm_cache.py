@@ -71,6 +71,17 @@ SHAPES += [
     ("serial", FLEET_WD_LANE_KW, FLEET_B, FLEET_CHUNK),
 ]
 
+# Sanitizer (audit/sanitize.py) twins of the micro fleet pair: the
+# checkify-instrumented chunk is its OWN executable (error plumbing wraps
+# the whole scan), and tests/test_audit.py smokes it in tier-1 at exactly
+# these shapes — warm or pay a cold compile inside the 870 s budget.  The
+# graph-audit traces themselves (scripts/graph_audit.py) never compile,
+# so they need no warming.
+SANITIZE_SHAPES = [
+    ("serial", FLEET_SER_KW, FLEET_B, FLEET_CHUNK),
+    ("parallel", FLEET_LANE_KW, FLEET_B, FLEET_CHUNK),
+]
+
 # (engine, SimParams kwargs, batch, chunk, dp): the sharded twins —
 # run_sharded pads batch to the mesh size, so warming with the same raw
 # batch reproduces the compiled shard shapes (which since the stream PR
@@ -119,6 +130,30 @@ if kw.get("watchdog") and batch is not None:
     st, _ = engine.make_run_fn(p, chunk, digest=True)(st)
 jax.block_until_ready(st)
 print("warmed", engine_name, kw, batch)
+"""
+
+
+SANITIZE_CHILD = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import sys, json
+import numpy as np
+sys.path.insert(0, %(root)r)
+from librabft_simulator_tpu.audit import sanitize
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import parallel_sim, simulator
+
+engine_name, kw, batch, chunk = json.loads(sys.argv[1])
+engine = parallel_sim if engine_name == "parallel" else simulator
+p = SimParams(max_clock=500, **kw)
+st = engine.init_batch(p, np.arange(batch, dtype=np.uint32))
+st = sanitize.run_checked(p, st, chunk, batched=True, engine=engine)
+jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+print("warmed sanitize", engine_name, kw, batch)
 """
 
 
@@ -196,6 +231,8 @@ def main():
             print(e, kw, b, c)
         for e, kw, b, c, dp in SHARDED_SHAPES:
             print(e, kw, b, c, f"dp={dp}")
+        for e, kw, b, c in SANITIZE_SHAPES:
+            print(e, kw, b, c, "sanitize")
         return
     if "--bench" in sys.argv:
         warm_bench(root)
@@ -218,6 +255,13 @@ def main():
              json.dumps([e, kw, b, c, dp])],
             cwd=root)
         print(f"[warm_cache] sharded {e} {kw} b={b} chunk={c} dp={dp}: "
+              f"rc={r.returncode}", flush=True)
+    for e, kw, b, c in SANITIZE_SHAPES:
+        r = subprocess.run(
+            [sys.executable, "-c", SANITIZE_CHILD % {"root": root},
+             json.dumps([e, kw, b, c])],
+            cwd=root)
+        print(f"[warm_cache] sanitize {e} {kw} b={b} chunk={c}: "
               f"rc={r.returncode}", flush=True)
 
 

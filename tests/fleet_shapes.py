@@ -6,6 +6,14 @@ compile-cache keys and the suite's compiled shapes can never drift apart
 (only max_clock differs between the two consumers, and max_clock is
 runtime data, outside the jit key).
 
+The checkify sanitizer (audit/sanitize.py) compiles its OWN executable on
+these same micro shapes: tests/test_audit.py's tier-1 smoke, the
+warm_cache SANITIZE_SHAPES children, and scripts/graph_audit.py
+--sanitize all use (FLEET_SER_KW / FLEET_LANE_KW, FLEET_B, FLEET_CHUNK),
+so the debug build is warmed by the same contract.  The graph-audit
+jaxpr traces never compile and key on nothing here (graph_lint.MICRO_*
+are capacity twins of these dicts minus the observability knobs).
+
 Pure data: no imports, safe to load from any process.
 """
 

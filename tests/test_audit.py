@@ -1,0 +1,417 @@
+"""Referees for the static-analysis subsystem (librabft_simulator_tpu/audit/).
+
+Three legs:
+
+1. **Seeded violations** — known-bad toy graphs (a traced-index scalar
+   scatter, a float leak into an int carry, a smuggled pure_callback, a
+   traced dynamic-update-slice) must each be flagged with the RIGHT rule
+   ID; known-good forms (one-hot wset, static-offset slice updates) must
+   pass.  An auditor nobody has watched catch a bug is worse than no
+   auditor — it retires review vigilance without replacing it.
+2. **Real engines pass clean** — both engines at the audit micro shapes
+   (graph_lint.MICRO_*) through R1-R4 + R6, and the dp-sharded runner
+   through R3/R5 + the mp arm of R6 — the tier-1 form of
+   ``scripts/graph_audit.py --assert-clean`` (CI runs the census-shape
+   matrix separately).
+3. **Sanitizer smoke** — the checkify build of both engines runs a micro
+   fleet chunk (the warmed tests/fleet_shapes.py contract) with no error,
+   values bit-identical to the unchecked engine, and a doctored state
+   trips the right invariant.
+
+Plus the source-lint fixtures (each S-rule on synthetic sources + the
+whole repo clean) and the budgets/knob-registry wiring.
+"""
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fleet_shapes import FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW
+from librabft_simulator_tpu.audit import graph_lint as GL
+from librabft_simulator_tpu.audit import knobs as KN
+from librabft_simulator_tpu.audit import sanitize as SAN
+from librabft_simulator_tpu.audit import source_lint as SL
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import parallel_sim as PE
+from librabft_simulator_tpu.sim import simulator as S
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings, severity="error"):
+    return {f.rule for f in findings if f.severity == severity}
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: seeded violations — wrong graphs flagged with the right rule ID.
+# ---------------------------------------------------------------------------
+
+
+class TestSeededViolations:
+    def test_scalar_traced_scatter_is_r1(self):
+        fs = GL.check_toy(lambda x, i: x.at[i].set(1),
+                          jnp.zeros(8, jnp.int32), jnp.int32(3))
+        assert "R1" in _rules(fs)
+        assert any("scalar" in f.summary for f in fs if f.rule == "R1")
+
+    def test_unwaived_vector_scatter_is_r1(self):
+        # Vector form at a toy (unwaived) site: flagged as error too —
+        # waivers are per registered engine file, not a global pass.
+        fs = GL.check_toy(lambda x, i: x.at[i].set(1),
+                          jnp.zeros(8, jnp.int32),
+                          jnp.arange(3, dtype=jnp.int32))
+        assert "R1" in _rules(fs)
+        assert any("unwaived" in f.summary for f in fs if f.rule == "R1")
+
+    def test_traced_dus_is_r1(self):
+        fs = GL.check_toy(
+            lambda x, i: jax.lax.dynamic_update_slice(
+                x, jnp.zeros((1,), jnp.int32), (i,)),
+            jnp.zeros(8, jnp.int32), jnp.int32(3))
+        assert "R1" in _rules(fs)
+
+    def test_static_dus_passes(self):
+        fs = GL.check_toy(
+            lambda x: jax.lax.dynamic_update_slice(
+                x, jnp.zeros((2,), jnp.int32), (3,)),
+            jnp.zeros(8, jnp.int32))
+        assert "R1" not in _rules(fs)
+
+    def test_onehot_wset_passes(self):
+        from librabft_simulator_tpu.utils.xops import wset
+        fs = GL.check_toy(lambda x, i: wset(x, i, 1),
+                          jnp.zeros(8, jnp.int32), jnp.int32(3))
+        assert not _rules(fs)
+
+    def test_float_carry_is_r2(self):
+        def leak(x):
+            def body(c, _):
+                ci, cf = c
+                return (ci + 1, cf * 1.5), ()
+            (ci, cf), _ = jax.lax.scan(body, (x, jnp.float32(1.0)),
+                                       None, length=4)
+            return ci + cf.astype(jnp.int32)
+        fs = GL.check_toy(leak, jnp.int32(0))
+        assert "R2" in _rules(fs)
+        assert any("carry" in f.summary for f in fs if f.rule == "R2")
+
+    def test_float_eqn_is_r2(self):
+        fs = GL.check_toy(
+            lambda x: (x.astype(jnp.float32) * 2.0).astype(jnp.int32),
+            jnp.zeros(4, jnp.int32))
+        assert "R2" in _rules(fs)
+
+    def test_smuggled_pure_callback_is_r3(self):
+        def smuggle(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v) + 1,
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        fs = GL.check_toy(smuggle, jnp.zeros(4, jnp.int32))
+        assert "R3" in _rules(fs)
+
+    def test_debug_callback_is_r3(self):
+        def tap(x):
+            jax.debug.callback(lambda v: None, x)
+            return x + 1
+        fs = GL.check_toy(tap, jnp.zeros(4, jnp.int32))
+        assert "R3" in _rules(fs)
+
+    def test_integer_graph_passes_r2_r3(self):
+        fs = GL.check_toy(lambda x: jnp.cumsum(x) + jnp.max(x),
+                          jnp.zeros(8, jnp.int32))
+        assert not _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: the real engines audit clean at the micro shapes.
+# ---------------------------------------------------------------------------
+
+
+class TestEnginesClean:
+    def test_serial_clean_with_r6(self):
+        findings, stats = GL.audit_engine("serial", GL.MICRO_SER_KW)
+        errors = [f for f in findings if f.severity == "error"]
+        assert errors == []
+        # The TPU-shape serial graph carries exactly the one waived
+        # vector scatter (free-slot ranks) and zero float eqns.
+        st = stats["serial/tpu_shape"]
+        assert st["writes"]["scalar"] == 0
+        assert st["writes"]["vector"] == st["writes"]["vector_waived"]
+        assert st["float_eqns"] == 0
+
+    def test_lane_clean(self):
+        # R6 (the DCE pass) for the lane engine runs in the CI census-
+        # shape audit; the tier-1 leg keeps to R1-R4 to bound trace time.
+        findings, stats = GL.audit_engine(
+            "lane", GL.MICRO_LANE_KW, r6=False,
+            flavors=("tpu_shape", "tpu_telemetry"))
+        errors = [f for f in findings if f.severity == "error"]
+        assert errors == []
+        st = stats["lane/tpu_shape"]
+        assert st["writes"]["scalar"] == 0
+        assert st["writes"]["vector"] == st["writes"]["vector_waived"] > 0
+        assert st["float_eqns"] == 0
+
+    def test_sharded_digest_contract(self):
+        findings, stats = GL.audit_sharded(GL.MICRO_SER_KW)
+        assert [f for f in findings if f.severity == "error"] == []
+        assert stats["sharded/tpu_shape"]["padded_batch"] == 6  # 5 -> 2-mesh
+
+    def test_digest_width_pinned(self):
+        from librabft_simulator_tpu.telemetry import stream as tstream
+        assert GL.DIGEST_WIDTH == tstream.DIGEST_WIDTH == 13
+
+
+# ---------------------------------------------------------------------------
+# Source lint: fixtures + the repo itself.
+# ---------------------------------------------------------------------------
+
+
+class TestSourceLint:
+    def test_unregistered_knob_is_s3(self):
+        fs = SL.lint_text(
+            "scripts/example.py",
+            "import os\nv = os.environ.get('LIBRABFT_BOGUS_KNOB')\n")
+        assert {f.rule for f in fs} == {"S3"}
+        assert "LIBRABFT_BOGUS_KNOB" in fs[0].summary
+
+    def test_registered_and_external_keys_pass(self):
+        fs = SL.lint_text(
+            "scripts/example.py",
+            "import os\n"
+            "a = os.environ.get('LIBRABFT_PACKED')\n"
+            "b = os.environ.get('JAX_PLATFORMS')\n")
+        assert fs == []
+
+    def test_unresolvable_key_is_s3(self):
+        fs = SL.lint_text(
+            "scripts/example.py",
+            "import os\nv = os.environ.get('PREFIX_' + name)\n")
+        assert {f.rule for f in fs} == {"S3"}
+
+    def test_constant_resolved_key(self):
+        fs = SL.lint_text(
+            "scripts/example.py",
+            "import os\nKEY = 'LIBRABFT_WRITE_MODE'\n"
+            "v = os.environ.get(KEY)\n")
+        assert fs == []
+
+    def test_unsanctioned_device_get_is_s2(self):
+        fs = SL.lint_text(
+            "parallel/sharded.py",
+            "import jax\n"
+            "def sneaky_poll(st):\n"
+            "    return jax.device_get(st.halted)\n")
+        assert "S2" in {f.rule for f in fs}
+
+    def test_bare_name_device_get_is_s2(self):
+        # `from jax import device_get` must not bypass the rule.
+        fs = SL.lint_text(
+            "parallel/sharded.py",
+            "from jax import device_get\n"
+            "def sneaky_poll(st):\n"
+            "    return device_get(st.halted)\n")
+        assert "S2" in {f.rule for f in fs}
+
+    def test_sanctioned_site_passes(self):
+        fs = SL.lint_text(
+            "parallel/sharded.py",
+            "import jax\n"
+            "def _poll_digest(dg):\n"
+            "    return jax.device_get(dg)\n")
+        assert fs == []
+
+    def test_np_in_traced_code_is_s1(self):
+        fs = SL.lint_text(
+            "sim/simulator.py",
+            "import numpy as np\n"
+            "def step(p, delay_table, dur_table, st):\n"
+            "    return np.maximum(st, 0)\n")
+        assert "S1" in {f.rule for f in fs}
+
+    def test_if_on_tracer_is_s1(self):
+        fs = SL.lint_text(
+            "sim/simulator.py",
+            "def step(p, delay_table, dur_table, st):\n"
+            "    if st.halted:\n"
+            "        return st\n"
+            "    return st\n")
+        assert any(f.rule == "S1" and "tracer" in f.summary for f in fs)
+
+    def test_if_on_params_passes(self):
+        fs = SL.lint_text(
+            "sim/simulator.py",
+            "def step(p, delay_table, dur_table, st):\n"
+            "    if p.telemetry:\n"
+            "        return st\n"
+            "    return st\n")
+        assert fs == []
+
+    def test_repo_is_clean(self):
+        fs = SL.run(REPO)
+        assert [f"{f.rule} {f.site}: {f.summary[:60]}" for f in fs] == []
+
+
+# ---------------------------------------------------------------------------
+# Budgets + knob registry wiring.
+# ---------------------------------------------------------------------------
+
+
+class TestBudgetsAndKnobs:
+    def test_budgets_single_source(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "budgets.py"),
+             "--sh"], capture_output=True, text=True, check=True).stdout
+        for var in ("CENSUS_BUDGET", "TELEMETRY_CENSUS_BUDGET",
+                    "WATCHDOG_CENSUS_BUDGET", "SHARDED_CENSUS_BUDGET",
+                    "TIER1_MIN_DOTS"):
+            assert var in out
+        # ci_tier1.sh consumes the eval line and holds no inline default.
+        with open(os.path.join(REPO, "scripts", "ci_tier1.sh")) as f:
+            sh = f.read()
+        assert "budgets.py --sh" in sh
+
+    def test_budget_values_sane(self):
+        ns = SL._load_budgets(REPO)
+        assert set(ns) == {"census_off", "census_telemetry",
+                           "census_watchdog", "census_sharded",
+                           "tier1_min_dots"}
+        # The watchdog's ON budget IS the off budget (zero-fusion cost,
+        # KERNEL_CENSUS_r09) — a drift here is a real decision, not noise.
+        assert ns["census_watchdog"] == ns["census_off"]
+        assert ns["census_telemetry"] > ns["census_off"]
+
+    def test_readme_knob_table_in_sync(self):
+        assert KN.readme_in_sync()
+
+    def test_every_knob_prefix_grouped(self):
+        for k in KN.KNOBS:
+            assert k.group in ("engine", "bench", "fuzz", "script"), k
+            assert k.desc and k.where and k.values, k
+
+
+# ---------------------------------------------------------------------------
+# Leg 3: the checkify sanitizer (tier-1 smoke; shapes warmed via
+# scripts/warm_cache.py SANITIZE_SHAPES — the fleet_shapes contract).
+# ---------------------------------------------------------------------------
+
+
+class TestSanitizer:
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv(SAN.CHECKIFY_ENV, raising=False)
+        assert not SAN.enabled()
+        monkeypatch.setenv(SAN.CHECKIFY_ENV, "1")
+        assert SAN.enabled()
+        monkeypatch.setenv(SAN.CHECKIFY_ENV, "off")
+        assert not SAN.enabled()
+
+    def test_serial_smoke_and_bit_identity(self):
+        p = SimParams(max_clock=500, **FLEET_SER_KW)
+        seeds = np.arange(FLEET_B, dtype=np.uint32)
+        checked = SAN.run_checked(p, S.init_batch(p, seeds), FLEET_CHUNK,
+                                  batched=True, engine=S)
+        plain = S.make_run_fn(p, FLEET_CHUNK)(
+            S.dedupe_buffers(S.init_batch(p, seeds)))
+        for a, b in zip(jax.tree_util.tree_leaves(checked),
+                        jax.tree_util.tree_leaves(plain)):
+            assert jnp.array_equal(a, b)
+
+    def test_lane_smoke(self):
+        p = SimParams(max_clock=500, **FLEET_LANE_KW)
+        st = PE.init_batch(p, np.arange(FLEET_B, dtype=np.uint32))
+        out = SAN.run_checked(p, st, FLEET_CHUNK, batched=True, engine=PE)
+        assert int(jnp.sum(out.n_events)) > 0
+
+    def test_doctored_state_trips(self):
+        from jax.experimental import checkify
+        p = SimParams(max_clock=500, **FLEET_SER_KW)
+        st = S.init_batch(p, np.arange(FLEET_B, dtype=np.uint32))
+        bad = st.replace(n_events=st.n_events - jnp.int32(100))
+        with pytest.raises(checkify.JaxRuntimeError,
+                           match="n_events wrapped negative"):
+            SAN.run_checked(p, bad, FLEET_CHUNK, batched=True, engine=S)
+
+    def test_doctored_ledger_trips(self):
+        from jax.experimental import checkify
+        p = SimParams(max_clock=500, **FLEET_SER_KW)
+        st = S.init_batch(p, np.arange(FLEET_B, dtype=np.uint32))
+        bad = st.replace(ctx=st.ctx.replace(
+            skipped_commits=st.ctx.skipped_commits + jnp.int32(1)))
+        with pytest.raises(checkify.JaxRuntimeError,
+                           match="commit ledger inconsistent"):
+            SAN.run_checked(p, bad, FLEET_CHUNK, batched=True, engine=S)
+
+    def test_stream_plus_checkify_refused(self, monkeypatch):
+        # The stream loop runs the UNchecked chunk; pretending it was
+        # invariant-checked would be worse than not checking — refuse.
+        from librabft_simulator_tpu.telemetry import stream as tstream
+        monkeypatch.setenv(SAN.CHECKIFY_ENV, "1")
+        p = SimParams(max_clock=500, **FLEET_SER_KW)
+        st = S.init_batch(p, np.arange(FLEET_B, dtype=np.uint32))
+        rec = tstream.TimelineRecorder(p)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            S.run_to_completion(p, st, chunk=FLEET_CHUNK, max_chunks=1,
+                                batched=True, stream=rec)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            PE.run_to_completion(
+                SimParams(max_clock=500, **FLEET_LANE_KW),
+                PE.init_batch(SimParams(max_clock=500, **FLEET_LANE_KW),
+                              np.arange(FLEET_B, dtype=np.uint32)),
+                chunk=FLEET_CHUNK, max_chunks=1, batched=True, stream=rec)
+
+    def test_run_to_completion_wiring(self, monkeypatch):
+        # LIBRABFT_CHECKIFY=1 routes run_to_completion through the
+        # checked chunk — same executable as the smoke above (the params
+        # and chunk match the fleet_shapes contract), same trajectory.
+        monkeypatch.setenv(SAN.CHECKIFY_ENV, "1")
+        p = SimParams(max_clock=500, **FLEET_SER_KW)
+        seeds = np.arange(FLEET_B, dtype=np.uint32)
+        out = S.run_to_completion(p, S.init_batch(p, seeds),
+                                  chunk=FLEET_CHUNK, max_chunks=2,
+                                  batched=True)
+        monkeypatch.delenv(SAN.CHECKIFY_ENV)
+        ref = S.run_to_completion(p, S.init_batch(p, seeds),
+                                  chunk=FLEET_CHUNK, max_chunks=2,
+                                  batched=True)
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(ref)):
+            assert jnp.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# R6 on the serial engine at micro shape is covered by
+# TestEnginesClean.test_serial_clean_with_r6; pin one structural detail the
+# audit relies on so a jax upgrade that breaks DCE comparison fails loud
+# here instead of silently passing everything.
+# ---------------------------------------------------------------------------
+
+
+def test_r6_detects_feedback():
+    """A graph where the 'telemetry' value DOES feed consensus must NOT
+    compare equal under the R6 DCE construction."""
+    from jax.interpreters import partial_eval as pe
+
+    def make(feedback):
+        def f(x, m):
+            m2 = m + jnp.sum(x)              # telemetry write
+            x2 = x + (m2[0] if feedback else 0)  # feedback into consensus
+            return x2, m2
+        return f
+
+    x = jnp.zeros(4, jnp.int32)
+    m = jnp.zeros(3, jnp.int32)
+
+    def sliced_sig(fn):
+        cj = jax.make_jaxpr(fn)(x, m)
+        dj, _ = pe.dce_jaxpr(cj.jaxpr, [True, False])  # keep consensus out
+        return GL.eqn_signature(dj)
+
+    clean = sliced_sig(make(False))
+    leaky = sliced_sig(make(True))
+    assert clean != leaky
