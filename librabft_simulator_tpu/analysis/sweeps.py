@@ -151,17 +151,25 @@ def baseline_configs(scale: float = 1.0) -> dict:
 
 def run_all(scale: float = 1.0, out_path: str | None = None,
             telemetry: bool = False, dp: int = 0,
-            stream_out: str | None = None, watchdog: bool = False) -> dict:
+            stream_out: str | None = None, watchdog: bool = False,
+            macro_k: int = 0) -> dict:
     """``stream_out`` streams every non-sweep config's per-chunk digest
     timeline as NDJSON — one file per config, ``{stem}.{config}.ndjson``
     (watch any of them live with scripts/fleet_watch.py) — and attaches
-    the timeline summary to the config's result row."""
+    the timeline summary to the config's result row.  ``macro_k > 0``
+    arms the serial engine's K-event macro-steps on the serial-engine
+    configs (the lane configs keep their horizon windows — macro_k is a
+    serial-engine knob and the lane engine refuses it); the run budget
+    stays RUN_CHUNK x RUN_MAX_CHUNKS macro-steps, i.e. K-fold more
+    events, with trajectories bit-identical per instance."""
     results = {}
     for name, (p, n, f_mode) in baseline_configs(scale).items():
         if telemetry:
             p = dataclasses.replace(p, telemetry=True)
         if watchdog:
             p = dataclasses.replace(p, watchdog=True)
+        if macro_k > 0 and f_mode != "parallel":
+            p = dataclasses.replace(p, macro_k=macro_k)
         if f_mode == "sweep":
             # f > 0 batches stay on the single-device serial path (see
             # run_config); the dp mesh applies to the plain fleet configs.
@@ -216,6 +224,11 @@ def main(argv=None):
     ap.add_argument("--watchdog", action="store_true",
                     help="run with SimParams.watchdog on so the streamed "
                          "digests carry live consensus-anomaly trip counts")
+    ap.add_argument("--macro-k", type=int, default=0, metavar="K",
+                    help="arm the serial engine's K-event macro-steps "
+                         "(SimParams.macro_k) on the serial-engine "
+                         "configs: each dispatched step retires K events, "
+                         "bit-identically (lane configs are unaffected)")
     args = ap.parse_args(argv)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -227,7 +240,7 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
     results = run_all(args.scale, args.out, telemetry=args.telemetry,
                       dp=args.dp, stream_out=args.stream_out,
-                      watchdog=args.watchdog)
+                      watchdog=args.watchdog, macro_k=args.macro_k)
     print(json.dumps(results, indent=2))
 
 

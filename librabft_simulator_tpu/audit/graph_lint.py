@@ -123,6 +123,10 @@ R1_EXPECTED_WAIVED = {
     "serial/tpu_shape": 1,        # free-slot rank scatter
     "serial/tpu_telemetry": 1,
     "serial/tpu_watchdog": 1,
+    # K-macro flavors: the rolled inner scan's body is traced ONCE, so
+    # the jaxpr carries the same single waived site regardless of K.
+    "serial/tpu_shape_k4": 1,
+    "serial/tpu_shape_k16": 1,
     "lane/tpu_shape": 13,         # lane scatter-back + inbox routing
     "lane/tpu_telemetry": 14,     # + the flight-recorder ring scatter
     "lane/tpu_watchdog": 13,
@@ -404,9 +408,12 @@ def _engine(name: str):
 def trace_step(engine_name: str, p: SimParams):
     """``(closed_jaxpr, out_paths, out_avals)`` of one engine's
     single-instance step at params ``p`` (packed layout applied when the
-    flavor asks for it, exactly as the compiled scan body does).  The step
-    is state-in/state-out, so the input tree's paths label the trace's
-    output leaves — no second trace needed."""
+    flavor asks for it, exactly as the compiled scan body does).  For the
+    serial engine with ``macro_k > 1`` the traced unit is the engine's
+    own ``macro_step`` (the K-event chunk body — the same function the
+    census compiles), so the audited and dispatched graphs are one trace.
+    The step is state-in/state-out, so the input tree's paths label the
+    trace's output leaves — no second trace needed."""
     eng = _engine(engine_name)
     st = eng.init_state(p, 0)
     dt = jnp.asarray(p.delay_table())
@@ -414,7 +421,8 @@ def trace_step(engine_name: str, p: SimParams):
     if engine_name == "serial":
         if p.packed:
             st = packing.pack_state(p, st)
-        cj = jax.make_jaxpr(functools.partial(eng.step, p))(dt, du, st)
+        fn = eng.macro_step if (p.macro_k or 1) > 1 else eng.step
+        cj = jax.make_jaxpr(functools.partial(fn, p))(dt, du, st)
     else:
         if p.packed:
             st = eng.pack_pstate(p, st)
@@ -577,10 +585,13 @@ def check_r6_mp(p_base: SimParams, batch: int, dp: int,
 # ---------------------------------------------------------------------------
 
 
-def _flavors(base_kw: dict):
+def _flavors(base_kw: dict, engine_name: str = "serial"):
     """(name, forms, rules) per engine flavor.  cpu_default keeps its
-    proven scatter forms, so R1 (a TPU-lowering rule) does not apply."""
-    return [
+    proven scatter forms, so R1 (a TPU-lowering rule) does not apply.
+    The serial engine adds the K-macro flavors (``macro_step``'s rolled
+    inner scan at K=4/16 — the census rungs), which run the same
+    R1-R4 write/dtype/callback/carry rules on the K-event graph."""
+    flavors = [
         ("cpu_default", CPU_FORMS, ("R2", "R3", "R4")),
         ("tpu_shape", TPU_FORMS, ("R1", "R2", "R3", "R4")),
         ("tpu_telemetry", dict(TPU_FORMS, telemetry=True, flight_cap=32),
@@ -588,6 +599,43 @@ def _flavors(base_kw: dict):
         ("tpu_watchdog", dict(TPU_FORMS, watchdog=True),
          ("R1", "R2", "R3", "R4")),
     ]
+    if engine_name == "serial":
+        flavors += [
+            ("tpu_shape_k4", dict(TPU_FORMS, macro_k=4),
+             ("R1", "R2", "R3", "R4")),
+            ("tpu_shape_k16", dict(TPU_FORMS, macro_k=16),
+             ("R1", "R2", "R3", "R4")),
+        ]
+    return flavors
+
+
+def check_r6_macro(engine_name: str, base_kw: dict,
+                   traces: dict | None = None) -> list[Finding]:
+    """The macro knob's R6 arm: ``macro_k=1`` must lower to the EXACT
+    macro-free graph — ``macro_step`` at K=1 and the bare ``step`` must
+    trace to identical eqn sequences.  This is the static twin of the
+    census K=1-identity gate: the default can never silently grow a
+    wrapper."""
+    traces = dict(traces or {})
+    if "tpu_shape" in traces:
+        cj_off, _ = traces["tpu_shape"]
+    else:
+        cj_off, _, _ = trace_step(
+            engine_name, SimParams(**base_kw, **TPU_FORMS))
+    eng = _engine(engine_name)
+    p1 = SimParams(**base_kw, **TPU_FORMS, macro_k=1)
+    st = eng.init_state(p1, 0)
+    if p1.packed:
+        st = packing.pack_state(p1, st)
+    cj_k1 = jax.make_jaxpr(functools.partial(eng.macro_step, p1))(
+        jnp.asarray(p1.delay_table()), jnp.asarray(p1.duration_table()), st)
+    if eqn_signature(cj_k1.jaxpr) != eqn_signature(cj_off.jaxpr):
+        return [Finding(
+            "R6", f"{engine_name}/tpu_shape_k1", "error",
+            "macro_k=1 is not the identity lowering: macro_step's K=1 "
+            "graph differs from the bare step — the default no longer "
+            "lowers to the exact pre-macro graph", "")]
+    return []
 
 
 def audit_engine(engine_name: str, base_kw: dict, r6: bool = True,
@@ -596,13 +644,13 @@ def audit_engine(engine_name: str, base_kw: dict, r6: bool = True,
     ``base_kw``; returns (findings, per-flavor stats)."""
     findings, stats, traces = [], {}, {}
     wanted = set(flavors) if flavors is not None else None
-    for name, forms, rules in _flavors(base_kw):
+    for name, forms, rules in _flavors(base_kw, engine_name):
         if wanted is not None and name not in wanted:
             continue
         flavor = f"{engine_name}/{name}"
         p = SimParams(**base_kw, **forms)
         cj, paths, out_avals = trace_step(engine_name, p)
-        if name != "cpu_default":
+        if name != "cpu_default" and "macro_k" not in forms:
             traces[name] = (cj, paths)  # R6 reuses the TPU-form traces
         st = {"eqns": sum(1 for _ in iter_eqns(cj.jaxpr)),
               "eqn_hash": signature_hash(cj.jaxpr)}
@@ -631,6 +679,8 @@ def audit_engine(engine_name: str, base_kw: dict, r6: bool = True,
     if r6:
         findings += check_r6_engine(engine_name, base_kw, engine_name,
                                     traces=traces)
+        if engine_name == "serial":
+            findings += check_r6_macro(engine_name, base_kw, traces=traces)
     return findings, stats
 
 

@@ -47,6 +47,10 @@ KNOBS: tuple[Knob, ...] = (
     Knob("LIBRABFT_GATE_HANDLERS", "engine", "utils/xops.py", "0|1",
          "A/B override for lax.cond handler gating "
          "(SimParams.gate_handlers=None resolves TPU->on)."),
+    Knob("LIBRABFT_MACRO_K", "engine", "utils/xops.py", "int >= 1",
+         "A/B override for the serial engine's K-event macro-steps "
+         "(SimParams.macro_k=None resolves env->K, else 1; each "
+         "dispatched step retires K events, bit-identically)."),
     Knob("LIBRABFT_CHECKIFY", "engine", "audit/sanitize.py", "0|1",
          "Debug: run_to_completion runs the checkify-instrumented chunk "
          "(state-invariant + div checks) and raises on the first trip; "
@@ -111,9 +115,26 @@ KNOBS: tuple[Knob, ...] = (
          "NDJSON timeline path for BENCH_STREAM."),
     Knob("BENCH_WATCHDOG", "bench", "bench.py", "1",
          "Arm the consensus watchdog in the fleet ladder."),
+    Knob("BENCH_MACRO", "bench", "bench.py", "1",
+         "Run the macro-step K-ladder (K in BENCH_MACRO_KS, one "
+         "subprocess per rung): ev/s + fusions-per-event per rung, "
+         "BENCH_MACRO_r11.json artifact (CPU-lowering proxy)."),
+    Knob("BENCH_MACRO_CHILD", "bench", "bench.py", "K",
+         "Internal: marks a macro-ladder rung child."),
+    Knob("BENCH_MACRO_KS", "bench", "bench.py", "k1,k2,...",
+         "Macro-ladder rungs (default 1,4,16,64)."),
+    Knob("BENCH_MACRO_OUT", "bench", "bench.py", "path",
+         "Macro-ladder artifact path."),
+    Knob("BENCH_MACRO_CENSUS", "bench", "bench.py", "0|1",
+         "Census fusions-per-event per macro rung (default on; off "
+         "skips the second compile per rung)."),
     # --- fuzz -----------------------------------------------------------
     Knob("FUZZ_PACKED", "fuzz", "scripts/fuzz_parity.py", "0|1",
          "Run every fuzz trial on the packed-plane engine."),
+    Knob("FUZZ_MACRO_K", "fuzz", "scripts/fuzz_parity.py", "0|1",
+         "Randomize the serial engine's macro_k per trial (K in "
+         "{1,2,4,8}; minidumps record it); writes the macro-flavor "
+         "campaign artifact FUZZ_PARITY_r11_macro.json."),
     # --- script-local ---------------------------------------------------
     Knob("LADDER_UNROLL", "script", "scripts/tpu_ladder.py", "0|1",
          "Census/ladder the unrolled-scan variant."),

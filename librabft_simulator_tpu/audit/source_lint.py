@@ -92,7 +92,7 @@ HOST_FUNCTIONS = {
                             "load_ndjson"},
     "core/types.py": {"payload_width"},
     "utils/xops.py": {"backend_mode", "packed_mode", "gate_mode",
-                      "resolve_params", "_bool_env"},
+                      "macro_mode", "resolve_params", "_bool_env"},
 }
 
 #: Whole classes that are host-side (every method exempt from S1).

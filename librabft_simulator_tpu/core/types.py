@@ -174,6 +174,25 @@ class SimParams:
     # build (tests/test_telemetry.py + the kernel-census CI gate).
     telemetry: bool = False
     flight_cap: int = 32      # K: flight-recorder ring rows (telemetry on)
+    # K-event macro-steps (sim/simulator.py::macro_step): the serial
+    # engine's dispatched unit of work retires macro_k queue events via a
+    # fixed-K rolled inner lax.scan instead of one — the Chandy–Misra
+    # lookahead idea the lane engine's horizon windows already exploit,
+    # applied to the dispatch axis: the ~per-step kernel-launch cost of
+    # the TPU execution model is amortized over K events per dispatched
+    # program.  Trajectories are bit-identical for every K (already-
+    # halted instances and drained queues make inner iterations exact
+    # no-ops — every write is live-gated, the pre-halted-padding idiom),
+    # so chunk runs compose bit-exactly across K (tests/test_checkpoint,
+    # tests/test_stream, FUZZ_MACRO_K campaigns).  Static compile key;
+    # num_steps/chunk arguments everywhere count MACRO-steps (each
+    # retiring macro_k events).  None = auto: LIBRABFT_MACRO_K env
+    # override, else 1 — and 1 lowers to the exact macro-free graph (the
+    # inner scan is skipped entirely; pinned by the graph audit's
+    # tpu_shape_k1 signature equality and the kernel census).  Serial
+    # engine only: the lane engine raises on macro_k > 1 (its windows
+    # are the same amortization by other means).
+    macro_k: int | None = None
     # In-graph consensus watchdog (telemetry/stream.py): a per-instance
     # [WD] int32 plane of anomaly detectors — liveness stall (no pacemaker
     # round advance for ``watchdog_stall_events`` processed events),
@@ -199,6 +218,11 @@ class SimParams:
                 f"flight_cap must be >= 1 when telemetry is on "
                 f"(got {self.flight_cap}); the flight-recorder ring "
                 "write indices are taken modulo flight_cap")
+        if self.macro_k is not None and self.macro_k < 1:
+            raise ValueError(
+                f"macro_k must be >= 1 (got {self.macro_k}); the serial "
+                "engine's dispatched unit retires macro_k events — zero "
+                "would dispatch empty programs forever")
         if self.watchdog and self.watchdog_stall_events < 1:
             raise ValueError(
                 f"watchdog_stall_events must be >= 1 when the watchdog is "

@@ -167,6 +167,14 @@ def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
     (tests/test_multichip.py).  Input buffers are donated: chunk k+1 reuses
     chunk k's memory in place.
 
+    ``num_steps`` counts MACRO-steps: with the serial engine's
+    ``SimParams.macro_k`` armed, each shard's chunk retires
+    ``num_steps * macro_k`` events per dispatch (sim/simulator.py
+    ``macro_step``) — the knob threads through ``engine.make_scan_fn``
+    unchanged, and the digest keeps reporting TRUE event counts (its
+    event/commit slots are in-state counters accumulated per inner
+    iteration, never per-dispatch tallies).
+
     The runner is memoized like the engines' ``_compiled_run``: params
     differing only in horizon/drop rate (which ride in SimState) share one
     executable; delay/duration-table variants re-trace, since the tables
@@ -246,6 +254,9 @@ def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
                 wrap: str = "shard_map", pad: bool = True, stream=None):
     """Pipelined host loop over sharded chunks until the whole fleet halts
     or ``num_steps`` is reached; returns the (unpadded) final state.
+    ``num_steps``/``chunk`` count macro-steps — with the serial engine's
+    ``SimParams.macro_k`` armed each chunk retires ``chunk * macro_k``
+    events per instance (see :func:`make_sharded_run_fn`).
 
     Double-buffered dispatch: chunk *k+1* is enqueued BEFORE chunk *k*'s
     digest is polled, so the host's one blocking sync per chunk
@@ -283,11 +294,15 @@ def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
     if stream is not None:
         stream.set_fleet(total=b_total, n_valid=n_valid)
     halted_slot = tstream.SLOT["halted"]
+    # Serial-engine macro-steps: the recorder's `steps` metadata stays
+    # per-instance EVENT-steps (each dispatched step retires k events);
+    # the digest's own counters are true in-state values regardless.
+    k = sim_ops.macro_k_of(xops.resolve_params(p)) if eng is sim_ops else 1
 
     def poll(dg, done_steps) -> bool:
         d = _poll_digest(dg)
         if stream is not None:
-            stream.record(d, steps=done_steps)
+            stream.record(d, steps=done_steps * k)
         return int(d[halted_slot]) >= b_total
 
     state, dg = run(state)

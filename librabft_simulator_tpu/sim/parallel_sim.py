@@ -894,6 +894,20 @@ def _compiled_digest_run(p_structural: SimParams, num_steps: int,
     return jax.jit(f, donate_argnums=(3,))
 
 
+def _reject_macro(p: SimParams) -> None:
+    """The serial engine's K-event macro-steps (SimParams.macro_k) do not
+    apply here: the lane engine already amortizes dispatch over whole
+    global-horizon windows — its ``num_steps`` unit IS a multi-event
+    window.  Silently ignoring the knob would fake a K-rung measurement,
+    so a macro-armed lane run fails loud instead."""
+    if (p.macro_k or 1) > 1:
+        raise ValueError(
+            f"SimParams.macro_k={p.macro_k} is a serial-engine knob; the "
+            "lane engine's horizon windows already batch events per "
+            "dispatch — run the serial engine, or set macro_k=None "
+            "(and unset LIBRABFT_MACRO_K) for lane runs")
+
+
 def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True,
                  d_min: int | None = None):
     """Uncompiled counterpart of :func:`make_run_fn` (same contract as
@@ -903,6 +917,7 @@ def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True,
     dmin = d_min_of(p) if d_min is None else d_min
     assert 1 <= dmin <= d_min_of(p), (dmin, d_min_of(p))
     p = xops.resolve_params(p)
+    _reject_macro(p)
     run = _scan_run(p.structural(), num_steps, batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
@@ -926,6 +941,7 @@ def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
     dmin = d_min_of(p) if d_min is None else d_min
     assert 1 <= dmin <= d_min_of(p), (dmin, d_min_of(p))
     p = xops.resolve_params(p)
+    _reject_macro(p)
     maker = _compiled_digest_run if digest else _compiled_run
     inner = maker(p.structural(), num_steps, batched)
     delay_table = jnp.asarray(p.delay_table())

@@ -602,9 +602,53 @@ def step_fn_partial(p: SimParams):
     return f
 
 
+def macro_k_of(p: SimParams) -> int:
+    """The resolved macro-step width (``SimParams.macro_k``; 1 when unset
+    — callers that bypass xops.resolve_params still get the identity)."""
+    return int(p.macro_k) if p.macro_k is not None else 1
+
+
+def macro_step(p_structural: SimParams, delay_table, dur_table, st):
+    """One dispatched unit of work: ``macro_k`` queue events via a fixed-K
+    rolled inner ``lax.scan`` over :func:`step`.
+
+    This is THE macro-step graph — ``_scan_run``'s chunk body, what the
+    kernel census censuses per K rung, and what the graph audit walks for
+    the ``tpu_shape_k{4,16}`` flavors — one definition so the measured,
+    audited, and executed graphs can never drift apart.  K == 1 returns
+    the bare :func:`step` with no wrapper at all, so the default lowers
+    to the exact macro-free graph (the census/audit K=1-identity pins).
+
+    Bit-exactness across K: already-halted instances and drained queues
+    make inner iterations exact no-ops (every write in :func:`step` is
+    gated on ``live = ~halted`` — the pre-halted fleet-padding idiom), so
+    a K-macro chunk equals K single-event chunks leaf-for-leaf and the
+    halt/digest poll only changes granularity, never trajectory.
+
+    Why a ROLLED inner scan: the body is traced once, so compile time,
+    jaxpr size, and the per-dispatch fusion count stay ~flat in K while
+    each dispatch retires K events — fusions per event drops ~K-fold on
+    the census (PERF_NOTES round 11).  Unrolling the inner scan instead
+    pays ~K-fold compile and graph growth for no cross-event fusion (XLA
+    will not fuse across sequentially-dependent steps; measured round
+    11); the unroll interplay on real TPU dispatch is a tunnel-checklist
+    re-measure.
+    """
+    k = macro_k_of(p_structural)
+    if k == 1:
+        return step(p_structural, delay_table, dur_table, st)
+
+    def body(s, _):
+        return step(p_structural, delay_table, dur_table, s), ()
+
+    st, _ = jax.lax.scan(body, st, None, length=k)
+    return st
+
+
 def _scan_run(p_structural: SimParams, num_steps: int, batched: bool):
-    """The raw (untransformed) chunk scan: ``num_steps`` events per
-    instance, pack/unpack at the boundary when the packed layout is on."""
+    """The raw (untransformed) chunk scan: ``num_steps`` macro-steps per
+    instance (``num_steps * macro_k`` events), pack/unpack at the boundary
+    when the packed layout is on."""
     packed = bool(p_structural.packed)
 
     def run(delay_table, dur_table, st):
@@ -612,7 +656,7 @@ def _scan_run(p_structural: SimParams, num_steps: int, batched: bool):
             st = packing.pack_state(p_structural, st)
 
         def body(s, _):
-            return step(p_structural, delay_table, dur_table, s), ()
+            return macro_step(p_structural, delay_table, dur_table, s), ()
 
         st, _ = jax.lax.scan(body, st, None, length=num_steps)
         if packed:
@@ -663,15 +707,19 @@ def make_scan_fn(p: SimParams, num_steps: int, batched: bool = True):
 
 def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
                 digest: bool = False):
-    """lax.scan of ``num_steps`` events per instance (loop_until).
+    """lax.scan of ``num_steps`` macro-steps per instance (loop_until) —
+    ``num_steps * macro_k`` events per dispatch (:func:`macro_step`;
+    ``macro_k`` defaults to 1 = one event per step, the exact historical
+    contract).
 
     The jitted executable is memoized on ``p.structural()`` — calls for
     params differing only in delay/drop/horizon reuse one compile.  The
-    'auto' lowering fields (packed planes, dense writes) are resolved
-    against the active backend here, before memoization.  ``digest=True``
-    returns ``st -> (st, [D] digest)`` (telemetry/stream.py): the fleet
-    health summary computed in-graph at the chunk boundary, so callers can
-    observe progress with one small fetch instead of a [B] plane."""
+    'auto' lowering fields (packed planes, dense writes, macro_k) are
+    resolved against the active backend/env here, before memoization.
+    ``digest=True`` returns ``st -> (st, [D] digest)``
+    (telemetry/stream.py): the fleet health summary computed in-graph at
+    the chunk boundary, so callers can observe progress with one small
+    fetch instead of a [B] plane."""
     p = xops.resolve_params(p)
     maker = _compiled_digest_run if digest else _compiled_run
     inner = maker(p.structural(), num_steps, batched)
@@ -693,18 +741,22 @@ RUN_CHUNK = 256
 RUN_MAX_CHUNKS = 400
 
 
-def stream_completion(run, st, chunk, max_chunks, batched, stream):
+def stream_completion(run, st, chunk, max_chunks, batched, stream,
+                      events_per_step: int = 1):
     """The digest-poll host loop both engines' ``run_to_completion`` share
     (telemetry/stream.py contract): ``run`` is a digest-flavor chunk fn
     (``st -> (st, [D])``); each chunk's halt check reads the one fetched
     digest vector — never a ``[B]`` plane — and every digest feeds the
-    recorder."""
+    recorder.  ``events_per_step`` is the macro width (serial engine's
+    resolved ``macro_k``): the recorder's ``steps`` metadata stays
+    per-instance EVENT-steps attempted, not dispatch counts — the digest's
+    event/commit slots are true in-state counters regardless."""
     b_total = (int(jax.tree_util.tree_leaves(st)[0].shape[0])
                if batched else 1)
     for i in range(max_chunks):
         st, dg = run(st)
         d = stream.record(np.asarray(jax.device_get(dg)),
-                          steps=(i + 1) * chunk)
+                          steps=(i + 1) * chunk * events_per_step)
         if d["halted"] >= b_total:
             break
     return st
@@ -714,6 +766,7 @@ def run_to_completion(p: SimParams, st: SimState, chunk: int = RUN_CHUNK,
                       max_chunks: int = RUN_MAX_CHUNKS,
                       batched: bool = False, stream=None):
     """Host loop: run until every instance passes max_clock (for tests).
+    ``chunk``/``max_chunks`` count macro-steps (``macro_k`` events each).
 
     ``stream`` (a telemetry/stream.TimelineRecorder) switches the loop to
     the digest contract: each chunk's halt check fetches the one [D]
@@ -732,7 +785,8 @@ def run_to_completion(p: SimParams, st: SimState, chunk: int = RUN_CHUNK,
                 "the knob or drop the recorder")
         return stream_completion(
             make_run_fn(p, chunk, batched=batched, digest=True), st,
-            chunk, max_chunks, batched, stream)
+            chunk, max_chunks, batched, stream,
+            events_per_step=macro_k_of(xops.resolve_params(p)))
     if sanitize.enabled():
         # LIBRABFT_CHECKIFY: run the checkify-instrumented debug build
         # (audit/sanitize.py) — bit-identical values, raises on the first

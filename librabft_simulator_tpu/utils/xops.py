@@ -112,21 +112,47 @@ def gate_mode(override=None) -> bool:
     return jax.default_backend() == "tpu"
 
 
+#: Environment override for the serial engine's K-event macro-steps
+#: (positive int); see ``SimParams.macro_k``.
+MACRO_ENV = "LIBRABFT_MACRO_K"
+
+
+def macro_mode(override=None) -> int:
+    """Resolve the macro-step width: explicit ``SimParams.macro_k`` >
+    ``MACRO_ENV`` env var > 1 (the exact macro-free graph).  Strict
+    parse — a malformed or non-positive value raises instead of silently
+    benching the wrong graph."""
+    if override is not None:
+        return int(override)
+    env = os.environ.get(MACRO_ENV, "").strip()
+    if not env:
+        return 1
+    try:
+        k = int(env)
+    except ValueError:
+        raise ValueError(f"{MACRO_ENV}={env!r}: want a positive integer")
+    if k < 1:
+        raise ValueError(f"{MACRO_ENV}={env!r}: want a positive integer")
+    return k
+
+
 def resolve_params(p):
     """Resolve the 'auto' lowering fields of a SimParams (``dense_writes``,
-    ``packed``, ``gate_handlers``) against the active backend.  Engines call
-    this at make-time, BEFORE ``structural()`` memoization, so every cached
-    executable is keyed by the concrete forms it was traced with."""
+    ``packed``, ``gate_handlers``, ``macro_k``) against the active backend
+    and environment.  Engines call this at make-time, BEFORE
+    ``structural()`` memoization, so every cached executable is keyed by
+    the concrete forms it was traced with."""
     import dataclasses
 
     mode = backend_mode(p.dense_writes)
     packed = packed_mode(p.packed)
     gate = gate_mode(p.gate_handlers)
+    macro = macro_mode(p.macro_k)
     if (mode == p.dense_writes and packed == p.packed
-            and gate == p.gate_handlers):
+            and gate == p.gate_handlers and macro == p.macro_k):
         return p
     return dataclasses.replace(p, dense_writes=mode, packed=packed,
-                               gate_handlers=gate)
+                               gate_handlers=gate, macro_k=macro)
 
 
 def scatter_set(dst, idx, src, *, mode: str = "scatter"):

@@ -13,19 +13,35 @@ flag help — drift between the copies was only a matter of time.  Now:
 * the source lint (audit/source_lint.py rule S4) flags any budget value
   reappearing as a literal on a budget-ish line elsewhere in scripts/.
 
-Provenance of the values:
+Provenance of the values (ROUND-11 RE-BASELINE): the container's
+jaxlib/XLA update changed both the optimizer's fusion decisions AND the
+HLO text format — tuple-typed computation-header params and
+``/*index=N*/`` type comments defeated the old census parser, which had
+been undercounting (the recorded 326/205/214/226 counts of rounds 6-10
+are not reproducible on this toolchain; the graphs themselves are
+unchanged — the graph audit's jaxpr signatures and R1 waived-site pins
+carried over exactly).  kernel_census.py's parser was repaired and every
+budget re-measured (KERNEL_CENSUS_r11.json, n=4/B=2048 CPU-lowering
+proxy, jax 0.4.37 / jaxlib 0.4.36 container); relative claims
+(telemetry small, K-macro amortization ~K-fold) hold on both
+toolchains.
 
-* ``census_off`` 220       — tpu_shape top fusions 205 (KERNEL_CENSUS_r06,
-  n=4/B=2048 CPU-lowering proxy) + ~7% headroom.
-* ``census_telemetry`` 230 — tpu_shape_telemetry 214 (KERNEL_CENSUS_r07:
-  +9 fusions for plane + flight recorder) + the same headroom.
-* ``census_watchdog`` 220  — the watchdog measured ZERO top-level fusion
-  cost (KERNEL_CENSUS_r09: 205 == off), so its ON budget IS the off
-  budget: a regression that makes disabled-quality detectors cost kernels
-  fails even if the off graph stays clean.
-* ``census_sharded`` 238   — per-shard program 222-226 (205 + scan/pack/
-  halt-digest overhead; KERNEL_CENSUS_r09) + headroom.
-* ``tier1_min_dots`` 39    — the seed suite's dot count at the 870 s
+* ``census_off`` 1070       — tpu_shape top fusions 1000 + ~7% headroom.
+* ``census_telemetry`` 1090 — tpu_shape_telemetry 1018 (+18 for plane +
+  flight recorder) + the same headroom.
+* ``census_watchdog`` 1080  — tpu_shape_watchdog 1006 (+6; the round-9
+  "zero-fusion watchdog" was a property of the old XLA's fusion choices
+  — on this toolchain the detectors cost 6 top-level fusion sites).
+* ``census_sharded`` 1160   — per-shard program 1081 (tpu_shape +
+  scan/pack/halt-digest overhead) + headroom.
+* ``census_k4`` 1090 / ``census_k16`` 1090 — the K-event macro-step
+  programs (SimParams.macro_k; sim/simulator.py macro_step): 1018 top
+  fusions at BOTH K=4 and K=16 — the rolled inner scan's body is one
+  step, so the dispatched program stays ~flat in K while retiring K
+  events (254.5 fusions/event at K=4, 63.6 at K=16 vs 1000 at K=1 =
+  15.7x amortization; the >=3x round-11 acceptance gate).  A K budget
+  ballooning toward K x census_off means the amortization silently died.
+* ``tier1_min_dots`` 39     — the seed suite's dot count at the 870 s
   timeout; PR baselines since run 49-59 (see CHANGES.md).
 
 Usage:
@@ -38,10 +54,12 @@ import json
 import sys
 
 BUDGETS = {
-    "census_off": 220,
-    "census_telemetry": 230,
-    "census_watchdog": 220,
-    "census_sharded": 238,
+    "census_off": 1070,
+    "census_telemetry": 1090,
+    "census_watchdog": 1080,
+    "census_sharded": 1160,
+    "census_k4": 1090,
+    "census_k16": 1090,
     "tier1_min_dots": 39,
 }
 
@@ -51,6 +69,8 @@ SH_VARS = {
     "census_telemetry": "TELEMETRY_CENSUS_BUDGET",
     "census_watchdog": "WATCHDOG_CENSUS_BUDGET",
     "census_sharded": "SHARDED_CENSUS_BUDGET",
+    "census_k4": "K4_CENSUS_BUDGET",
+    "census_k16": "K16_CENSUS_BUDGET",
     "tier1_min_dots": "TIER1_MIN_DOTS",
 }
 

@@ -40,8 +40,8 @@ echo "=== static graph + source audit (audit/: jaxpr rules R1-R6, source lint S1
 # Fail fast: audit traces are minutes of pure Python, cheaper than any
 # XLA compile below.  Emits the machine-readable artifact either way.
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
-    --assert-clean --out GRAPH_AUDIT_r10.json; then
-    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r10.json)" >&2
+    --assert-clean --out GRAPH_AUDIT_r11.json; then
+    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r11.json)" >&2
     exit 1
 fi
 
@@ -69,12 +69,14 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 parity_rc=$?
 
-echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard) ==="
+echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-max "${CENSUS_BUDGET}" \
     --assert-telemetry-max "${TELEMETRY_CENSUS_BUDGET}" \
     --assert-watchdog-max "${WATCHDOG_CENSUS_BUDGET}" \
-    --assert-sharded-max "${SHARDED_CENSUS_BUDGET}"
+    --assert-sharded-max "${SHARDED_CENSUS_BUDGET}" \
+    --assert-k4-max "${K4_CENSUS_BUDGET}" \
+    --assert-k16-max "${K16_CENSUS_BUDGET}"
 census_rc=$?
 
 tests_ok=0

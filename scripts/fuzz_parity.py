@@ -20,8 +20,10 @@ message counters, per-node committed chains, store heads, and lock rounds.
 
 Usage: python scripts/fuzz_parity.py [minutes]   # default 30
     FUZZ_PACKED=1 python scripts/fuzz_parity.py 10   # packed-plane engine
+    FUZZ_MACRO_K=1 python scripts/fuzz_parity.py 10  # randomize macro_k
 Writes FUZZ_PARITY_r05.json (FUZZ_PARITY_r06_packed.json under
-FUZZ_PACKED=1) {trials, structural_shapes, failures[]}.
+FUZZ_PACKED=1; FUZZ_PARITY_r11_macro.json under FUZZ_MACRO_K=1)
+{trials, structural_shapes, macro_trials, failures[]}.
 """
 
 from __future__ import annotations
@@ -69,6 +71,18 @@ STRUCTURAL = [
 from librabft_simulator_tpu.utils import xops  # noqa: E402
 
 PACKED = xops._bool_env("FUZZ_PACKED") or False
+
+# FUZZ_MACRO_K=1 randomizes the serial engine's K-event macro-step width
+# per trial (sim/simulator.py macro_step): the jitted side retires K
+# events per dispatched step while the oracle stays strictly per-event,
+# so any macro defect — a dropped halt gate, a carry mixup in the inner
+# scan, an off-by-one in the chunk budget — shows as a parity divergence.
+# K is a compile key, so the K axis multiplies structural compiles; the
+# set stays small and the runtime axes keep riding structural()
+# memoization.  Minidumps record macro_k via the full params dict and the
+# failure row.
+MACRO = xops._bool_env("FUZZ_MACRO_K") or False
+MACRO_KS = (1, 2, 4, 8)
 
 DELAYS = [
     dict(delay_kind="lognormal", delay_mean=10.0, delay_variance=4.0),
@@ -170,6 +184,7 @@ def main() -> int:
     rng = random.Random(0xF12A)
     trials = 0
     byz_trials = {"byz_equivocate": 0, "byz_silent": 0, "byz_forge_qc": 0}
+    macro_trials: dict = {}
     shapes_used = set()
     failures = []
     while time.time() < deadline:
@@ -178,9 +193,12 @@ def main() -> int:
         runtime = dict(rng.choice(DELAYS))
         runtime["drop_prob"] = rng.choice([0.0, 0.0, 0.02, 0.05, 0.15])
         runtime["max_clock"] = rng.choice([400, 800, 1500])
-        p = SimParams(**structural, **runtime, packed=PACKED)
+        macro_k = rng.choice(MACRO_KS) if MACRO else 1
+        p = SimParams(**structural, **runtime, packed=PACKED,
+                      macro_k=macro_k)
         seed = rng.randrange(2**31)
-        shapes_used.add(sk)
+        shapes_used.add((sk, macro_k))
+        macro_trials[macro_k] = macro_trials.get(macro_k, 0) + 1
         # Byzantine leg (~40% of trials): up to f = floor((n-1)/3) nodes
         # get a random attacker kind; masks are runtime data (SimState),
         # so this shares the honest trials' executables.
@@ -200,16 +218,21 @@ def main() -> int:
             minidump = write_minidump(p, seed, structural, runtime, byz,
                                       errs, len(failures))
             failures.append(dict(structural=structural, runtime=runtime,
-                                 seed=seed, byz=byz, errors=errs,
-                                 minidump=minidump))
+                                 macro_k=macro_k, seed=seed, byz=byz,
+                                 errors=errs, minidump=minidump))
             print(json.dumps(failures[-1]), flush=True)
         if trials % 10 == 0:
             print(f"[fuzz] {trials} trials, {len(shapes_used)} shapes, "
                   f"{len(failures)} failures", file=sys.stderr, flush=True)
     out = dict(trials=trials, byz_trials=byz_trials, packed=PACKED,
+               macro=MACRO,
+               macro_trials={str(k): v for k, v in
+                             sorted(macro_trials.items())},
                structural_shapes=len(shapes_used), failures=failures)
-    with open("FUZZ_PARITY_r06_packed.json" if PACKED
-              else "FUZZ_PARITY_r05.json", "w") as f:
+    artifact = ("FUZZ_PARITY_r11_macro.json" if MACRO
+                else "FUZZ_PARITY_r06_packed.json" if PACKED
+                else "FUZZ_PARITY_r05.json")
+    with open(artifact, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: v for k, v in out.items() if k != "failures"}
                      | {"n_failures": len(failures)}))

@@ -64,9 +64,18 @@ from librabft_simulator_tpu.core.types import SimParams  # noqa: E402
 from librabft_simulator_tpu.sim import simulator as S  # noqa: E402
 
 # Computation header: "%name (params) -> type {" (optionally "ENTRY ...").
-_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.-]+)\s*(\([^)]*\))?\s*->.*{")
-# Opcode(s) on an instruction line: "%name = type opcode(...)".
+# Params may carry TUPLE-typed entries (nested parens) — e.g. a while
+# body's "(param.1: (s32[], s32[2048,9]))" — so the param group is a
+# greedy any-match up to the "->", not a paren-free "\([^)]*\)" (round
+# 11: the old form silently skipped those headers and misattributed
+# their instructions to the previous computation).
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.-]+)\s*(\(.*\))?\s*->.*{")
+# Opcode(s) on an instruction line: "%name = type opcode(...)".  Long
+# tuple types embed "/*index=N*/" markers whose '=' broke the lazy
+# "[^=]*?" bridge (round 11: while instructions went uncounted);
+# hlo_counts strips comments per line before matching.
 _OP_RE = re.compile(r"=\s[^=]*?\s([\w-]+)\(")
+_COMMENT_RE = re.compile(r"/\*.*?\*/")
 
 # Ops that launch (or serialize into) their own kernel(s) when not fused.
 _DISPATCH_OPS = ("fusion", "scatter", "sort", "dot", "custom-call", "rng",
@@ -77,23 +86,33 @@ def hlo_counts(txt: str) -> dict:
     """Count ops per computation in optimized HLO text.
 
     The headline metric is ``top_fusions``: fusion calls in the entry
-    computation plus while-loop bodies — i.e. fusions actually dispatched
-    per step (XLA CPU also *nests* fusions inside fusion bodies; those are
-    inlined by the emitter, not separate launches, so raw fusion-instruction
-    totals overcount ~3x).  At n=4/B=2048 the pre-PR ``top_dispatch`` count
-    (334) matches the ~330 per-step kernels the round-5 on-chip profiler
-    saw, which is what qualifies this as the kernel-count proxy."""
+    computation plus while-loop bodies — i.e. fusion sites in the
+    dispatched program (XLA CPU also *nests* fusions inside fusion bodies;
+    those are inlined by the emitter, not separate launches, so raw
+    fusion-instruction totals overcount).  While bodies are counted ONCE
+    (static dispatch sites), the same convention the pre-existing protocol
+    whiles always had.  On the round-5 toolchain the pre-PR
+    ``top_dispatch`` count (334) matched the ~330 per-step kernels the
+    on-chip profiler saw, which is what qualifies this as the kernel-count
+    proxy; the round-11 container's jaxlib/XLA update changed both the
+    optimizer's fusion decisions and the HLO text format (tuple-typed
+    header params, ``/*index=N*/`` type comments), so the parser was
+    repaired and every budget re-baselined — see scripts/budgets.py
+    provenance and PERF_NOTES round 11."""
     comp = None
     per = collections.Counter()
+    while_bodies = set()
     for line in txt.splitlines():
+        line = _COMMENT_RE.sub("", line)
         m = _COMP_RE.match(line)
         if m:
             comp = ("ENTRY:" if m.group(1) else "") + m.group(2)
             continue
         for op in _OP_RE.findall(line):
             per[(comp or "?", op)] += 1
+        for b in re.findall(r"while\(.*?\).*?body=%?([\w.-]+)", line):
+            while_bodies.add(b)
     entry = next((c for c, _ in per if c.startswith("ENTRY:")), None)
-    while_bodies = set(re.findall(r"while\(.*?\).*?body=%?([\w.-]+)", txt))
 
     def top(pred):
         return sum(v for (c, op), v in per.items()
@@ -118,16 +137,27 @@ def census_step(p: SimParams, batch: int) -> dict:
     """Lower + compile the jitted vmapped serial step; count HLO ops.
 
     For packed params the step is lowered on the packed plane state (the
-    steady-state scan body), not the pack/unpack boundary."""
+    steady-state scan body), not the pack/unpack boundary.  With
+    ``p.macro_k > 1`` the censused unit is the engine's own
+    ``macro_step`` (the K-event rolled inner scan — the dispatched unit
+    of work), and ``events_per_dispatch``/``fusions_per_event`` record
+    the amortization: K events retire against one program's fusion
+    sites, so fusions per event drops ~K-fold while a K=1 macro census
+    is the bare step graph exactly (macro_step returns it unwrapped)."""
     st = S.init_batch(p, np.arange(batch, dtype=np.uint32))
     if p.packed:
         st = packing.pack_state(p, st)
     dt = jnp.asarray(p.delay_table())
     du = jnp.asarray(p.duration_table())
-    f = jax.jit(jax.vmap(functools.partial(S.step, p),
+    k = S.macro_k_of(p)
+    fn = S.macro_step if k > 1 else S.step
+    f = jax.jit(jax.vmap(functools.partial(fn, p),
                          in_axes=(None, None, 0)))
     compiled = f.lower(dt, du, st).compile()
-    return hlo_counts(compiled.as_text())
+    out = hlo_counts(compiled.as_text())
+    out["events_per_dispatch"] = k
+    out["fusions_per_event"] = round(out["top_fusions"] / k, 1)
+    return out
 
 
 def census_sharded(p: SimParams, batch: int, dp: int) -> dict:
@@ -185,6 +215,18 @@ MODES = {
     "tpu_shape_telemetry_watchdog": dict(packed=True, dense_writes="dense",
                                          gate_handlers=True, telemetry=True,
                                          watchdog=True),
+    # K-event macro-steps (SimParams.macro_k; sim/simulator.py
+    # macro_step): the dispatched unit retires K events via a rolled
+    # fixed-K inner scan, so the program's fusion count stays ~flat
+    # while fusions PER EVENT drops ~K-fold — the events/kernel
+    # multiplier on top of PR 1's kernels/step cut.  macro_k=1 is the
+    # bare tpu_shape graph exactly (no wrapper; the --assert-max gate
+    # covers it); the K rungs carry their own budgets
+    # (--assert-k4-max / --assert-k16-max, scripts/budgets.py).
+    "tpu_shape_k4": dict(packed=True, dense_writes="dense",
+                         gate_handlers=True, macro_k=4),
+    "tpu_shape_k16": dict(packed=True, dense_writes="dense",
+                          gate_handlers=True, macro_k=16),
 }
 
 
@@ -206,6 +248,13 @@ def main() -> int:
                          "count exceeds this budget (CI regression gate; "
                          "the watchdog-OFF graph is covered by --assert-max "
                          "— disabled detectors must cost zero kernels)")
+    ap.add_argument("--assert-k4-max", type=int, default=None,
+                    help="exit nonzero if the tpu_shape_k4 macro-step "
+                         "fusion count exceeds this budget (CI gate; "
+                         "the K=4 dispatched program — 4 events/launch)")
+    ap.add_argument("--assert-k16-max", type=int, default=None,
+                    help="exit nonzero if the tpu_shape_k16 macro-step "
+                         "fusion count exceeds this budget (CI gate)")
     ap.add_argument("--sharded", action="store_true",
                     help="also census the per-shard dp-fleet program "
                          "(shard_map runner on a 2-shard virtual CPU mesh)")
@@ -236,6 +285,10 @@ def main() -> int:
             args.assert_watchdog_max = b["census_watchdog"]
         if args.assert_sharded_max is None:
             args.assert_sharded_max = b["census_sharded"]
+        if args.assert_k4_max is None:
+            args.assert_k4_max = b["census_k4"]
+        if args.assert_k16_max is None:
+            args.assert_k16_max = b["census_k16"]
     if args.assert_sharded_max is not None:
         args.sharded = True
 
@@ -270,10 +323,14 @@ def main() -> int:
             p = dataclasses.replace(base, **kw)
             seen[key] = census_step(p, args.batch)
         out["modes"][name] = c = seen[key]
+        per_ev = (f" ev/dispatch={c['events_per_dispatch']:2d} "
+                  f"fusions/ev={c['fusions_per_event']:6.1f}"
+                  if c.get("events_per_dispatch", 1) > 1 else "")
         print(f"{name:18s} top_fusions={c['top_fusions']:4d} "
               f"top_dispatch={c['top_dispatch']:4d} "
               f"total_fusions={c['total_fusions']:5d} "
-              f"whiles={c['whiles']} scatters={c['scatters']}", flush=True)
+              f"whiles={c['whiles']} scatters={c['scatters']}{per_ev}",
+              flush=True)
 
     if args.sharded:
         p_sh = dataclasses.replace(base, **MODES["tpu_shape"])
@@ -311,6 +368,13 @@ def main() -> int:
         print(f"FAIL: tpu_shape_watchdog top-level fusion count {wdc} "
               f"exceeds budget {args.assert_watchdog_max}", file=sys.stderr)
         return 1
+    for kname, budget in (("tpu_shape_k4", args.assert_k4_max),
+                          ("tpu_shape_k16", args.assert_k16_max)):
+        kc = out["modes"][kname]["top_fusions"]
+        if budget is not None and kc > budget:
+            print(f"FAIL: {kname} macro-step fusion count {kc} exceeds "
+                  f"budget {budget}", file=sys.stderr)
+            return 1
     if args.assert_sharded_max is not None:
         sh = out["modes"]["sharded_tpu_shape"]["top_fusions"]
         if sh > args.assert_sharded_max:

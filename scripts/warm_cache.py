@@ -9,6 +9,7 @@ pair in its own subprocess, after which the suite runs from cache.
 Usage:  python scripts/warm_cache.py            # suite shapes (incl. sharded)
         python scripts/warm_cache.py --bench    # bench + 5-config sweep shapes
         python scripts/warm_cache.py --fleet    # BENCH_FLEET dp-ladder rungs
+        python scripts/warm_cache.py --macro    # BENCH_MACRO K-ladder rungs
         python scripts/warm_cache.py --list     # show shapes
 
 ``--bench`` drives bench.py itself (one child per config, BENCH_REPS=1) so
@@ -52,8 +53,8 @@ SHAPES = [
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", "tests"))
 from fleet_shapes import (  # noqa: E402
-    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW, FLEET_WD_LANE_KW,
-    FLEET_WD_SER_KW)
+    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_MACRO_SER_KW,
+    FLEET_MACRO_WD_SER_KW, FLEET_SER_KW, FLEET_WD_LANE_KW, FLEET_WD_SER_KW)
 
 # Unsharded reference runs of the tier-1 2-shard parity pair, plus the
 # watchdog-armed twins tests/test_stream.py runs (watchdog and its stall
@@ -69,6 +70,13 @@ SHAPES += [
     # tests/test_stream.py's queue-saturation pin: the 4-node shape on the
     # SERIAL (shared-queue) engine, watchdog armed.
     ("serial", FLEET_WD_LANE_KW, FLEET_B, FLEET_CHUNK),
+    # K-event macro-step rungs (SimParams.macro_k — a compile key: the
+    # inner-scan trip count is baked in).  The plain macro chunk feeds
+    # tests/test_checkpoint.py's macro-boundary round trip; the
+    # watchdog-armed twin feeds tests/test_stream.py's K>1 digest pins
+    # (its digest flavor compiles via the watchdog branch below).
+    ("serial", FLEET_MACRO_SER_KW, FLEET_B, FLEET_CHUNK),
+    ("serial", FLEET_MACRO_WD_SER_KW, FLEET_B, FLEET_CHUNK),
 ]
 
 # Sanitizer (audit/sanitize.py) twins of the micro fleet pair: the
@@ -92,6 +100,9 @@ SHARDED_SHAPES = [
     ("serial", FLEET_SER_KW, FLEET_B, FLEET_CHUNK, 2),
     ("parallel", FLEET_LANE_KW, FLEET_B, FLEET_CHUNK, 2),
     ("serial", FLEET_WD_SER_KW, FLEET_B, FLEET_CHUNK, 2),
+    # The macro-armed sharded twin: test_stream.py pins the per-chunk
+    # digest's true event accounting at K>1 through run_sharded.
+    ("serial", FLEET_MACRO_WD_SER_KW, FLEET_B, FLEET_CHUNK, 2),
 ]
 
 CHILD = r"""
@@ -198,6 +209,19 @@ def warm_fleet(root: str) -> None:
     print(f"[warm_cache] fleet ladder: rc={r.returncode}", flush=True)
 
 
+def warm_macro(root: str) -> None:
+    """Compile every BENCH_MACRO K-ladder rung into bench.py's persistent
+    cache (one subprocess per rung is the ladder's own protocol; the
+    census compile is skipped — only the timed chunk executables warm,
+    which is what a real BENCH_MACRO=1 run re-censuses anyway)."""
+    env = dict(os.environ, BENCH_MACRO="1", BENCH_REPS="1",
+               BENCH_MACRO_CENSUS="0",
+               BENCH_MACRO_OUT="/tmp/warm_macro.json")
+    r = subprocess.run([sys.executable, "bench.py"], cwd=root, env=env,
+                       stdout=subprocess.DEVNULL)
+    print(f"[warm_cache] macro ladder: rc={r.returncode}", flush=True)
+
+
 def warm_bench(root: str) -> None:
     """Compile every bench/sweep shape into bench.py's persistent cache.
 
@@ -239,6 +263,9 @@ def main():
         return
     if "--fleet" in sys.argv:
         warm_fleet(root)
+        return
+    if "--macro" in sys.argv:
+        warm_macro(root)
         return
     import json
 

@@ -35,3 +35,12 @@ FLEET_WD_SER_KW = dict(FLEET_SER_KW, watchdog=True,
                        watchdog_stall_events=FLEET_WD_STALL)
 FLEET_WD_LANE_KW = dict(FLEET_LANE_KW, watchdog=True,
                         watchdog_stall_events=FLEET_WD_STALL)
+
+# K-event macro-step twins (tests/test_checkpoint.py's macro-boundary
+# round trip, tests/test_stream.py's K>1 digest pins): the serial micro
+# shapes with SimParams.macro_k armed.  macro_k is a compile key (the
+# inner-scan trip count is baked into the chunk graph), so the suite's
+# K rung must match the warmed one exactly — single-sourced here.
+FLEET_MACRO_K = 4
+FLEET_MACRO_SER_KW = dict(FLEET_SER_KW, macro_k=FLEET_MACRO_K)
+FLEET_MACRO_WD_SER_KW = dict(FLEET_WD_SER_KW, macro_k=FLEET_MACRO_K)

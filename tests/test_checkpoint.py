@@ -134,6 +134,48 @@ def test_load_checkpoint_missing_new_fields(tmp_path):
                                   np.asarray(st.ctx.commit_count))
 
 
+def test_macro_step_boundary_roundtrip(tmp_path):
+    """K-event macro-steps (SimParams.macro_k) across a checkpoint: a K=4
+    run checkpointed mid-run restores and CONTINUES UNDER K=1
+    bit-identically — the state at a macro-step boundary is exactly the
+    K=1 state after the same number of events, so checkpoints are
+    portable across K (an operator can change the dispatch amortization
+    between save and resume without forking the trajectory).  Shapes are
+    the warmed tests/fleet_shapes.py micro contract (macro_k is a
+    compile key)."""
+    from fleet_shapes import (FLEET_B, FLEET_CHUNK, FLEET_MACRO_K,
+                              FLEET_MACRO_SER_KW, FLEET_SER_KW)
+
+    p1 = SimParams(max_clock=2**30, **FLEET_SER_KW)
+    p4 = SimParams(max_clock=2**30, **FLEET_MACRO_SER_KW)
+    seeds = np.arange(FLEET_B, dtype=np.uint32)
+    run1 = S.make_run_fn(p1, FLEET_CHUNK)   # FLEET_CHUNK events/chunk
+    run4 = S.make_run_fn(p4, FLEET_CHUNK)   # FLEET_CHUNK * K events/chunk
+
+    # One K=4 chunk, checkpointed at its macro-step boundary...
+    st4 = run4(S.dedupe_buffers(S.init_batch(p4, seeds)))
+    f = str(tmp_path / "macro.npz")
+    C.save(f, st4)
+    # ... restores exactly (same leaves back) ...
+    st_res = C.load(f, p1, like=S.init_batch(p1, np.zeros(FLEET_B, np.uint32)))
+    for a, b in zip(jax.tree.leaves(st4), jax.tree.leaves(st_res)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ... and a K=1 continuation of the restored state lands bit-identical
+    # to a pure K=1 run of the same total event count.
+    st_res = S.dedupe_buffers(st_res)
+    for _ in range(FLEET_MACRO_K):
+        st_res = run1(st_res)
+    st_ref = S.dedupe_buffers(S.init_batch(p1, seeds))
+    for _ in range(2 * FLEET_MACRO_K):
+        st_ref = run1(st_ref)
+    for (pt, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(st_ref)[0],
+            jax.tree_util.tree_flatten_with_path(st_res)[0]):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            "/".join(str(q) for q in pt))
+
+
 def test_watchdog_leaf_restore(tmp_path):
     """Round 9's consensus-watchdog plane through the checkpoint paths:
     (1) a watchdog-on save/load round-trips the wd counters exactly;

@@ -169,3 +169,14 @@ def test_inbox_cap_param_respected():
     p = small_params(inbox_cap=6)
     assert P.inbox_cap(p) == 6
     assert P.inbox_cap(small_params()) == 16
+
+
+def test_lane_engine_refuses_macro_k():
+    """SimParams.macro_k is a serial-engine knob (the lane engine's
+    horizon windows already batch events per dispatch) — a macro-armed
+    lane run must fail loud at make-time, never silently bench K=1."""
+    p = small_params(macro_k=2)
+    with pytest.raises(ValueError, match="serial-engine knob"):
+        P.make_run_fn(p, 4)
+    with pytest.raises(ValueError, match="serial-engine knob"):
+        P.make_scan_fn(p, 4)

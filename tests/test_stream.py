@@ -36,8 +36,8 @@ import numpy as np
 import pytest
 
 from fleet_shapes import (
-    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW, FLEET_WD_LANE_KW,
-    FLEET_WD_SER_KW)
+    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_MACRO_K,
+    FLEET_MACRO_WD_SER_KW, FLEET_SER_KW, FLEET_WD_LANE_KW, FLEET_WD_SER_KW)
 from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.oracle.sim import OracleSim
 from librabft_simulator_tpu.sim import parallel_sim as PE
@@ -47,6 +47,7 @@ from librabft_simulator_tpu.telemetry import stream as tstream
 
 P_SER = SimParams(max_clock=120, **FLEET_SER_KW)
 P_WD_SER = SimParams(max_clock=120, **FLEET_WD_SER_KW)
+P_MACRO_WD = SimParams(max_clock=120, **FLEET_MACRO_WD_SER_KW)
 P_LANE = SimParams(max_clock=150, **FLEET_LANE_KW)
 P_WD_LANE = SimParams(max_clock=150, **FLEET_WD_LANE_KW)
 SEEDS = np.arange(FLEET_B, dtype=np.uint32)
@@ -363,6 +364,60 @@ def test_run_report_carries_version_and_digest(ser_wd_run, tmp_path):
     path = str(tmp_path / "report.json")
     treport.save_report(path, rep)
     assert treport.load_report(path) == json.loads(json.dumps(rep))
+
+
+def test_digest_true_event_counts_at_macro_k(ser_wd_run, ser_oracles):
+    """K-event macro-steps through the digest contract: at macro_k=4 each
+    dispatched chunk retires K-fold more events, and the digest's
+    event/commit counters must stay TRUE in-state tallies (accounted per
+    inner iteration) — a per-dispatch tally would undercount K-fold.
+    Pinned three ways: the final digest equals the fold of the
+    per-event oracle digests exactly, the final state is bit-identical
+    to the K=1 run, and the chunk-1 row already carries K x chunk
+    event-steps of progress."""
+    rec = tstream.TimelineRecorder(p=P_MACRO_WD, total_instances=FLEET_B)
+    st = S.run_to_completion(P_MACRO_WD, byz_fleet_state(P_MACRO_WD, S),
+                             chunk=FLEET_CHUNK, batched=True, stream=rec)
+    dev = state_digest(P_MACRO_WD, st)
+    assert dev == tstream.fold_digests(o.digest() for o in ser_oracles)
+    assert dev["events"] > 0 and dev["halted"] == FLEET_B
+    # Bit-identity with the K=1 run of the same fleet (macro_k reshapes
+    # dispatch, never trajectory).
+    assert_trees_equal(ser_wd_run[0], st)
+    # The recorder's steps metadata counts EVENT-steps, not dispatches.
+    assert rec.rows[0]["steps"] == FLEET_CHUNK * FLEET_MACRO_K
+    # And the K=1 stream of the same horizon needed K-fold more chunks
+    # (same trajectory, fewer dispatches — the whole point).
+    k1_rows = len(ser_wd_run[1].rows)
+    assert len(rec.rows) < k1_rows
+    assert k1_rows <= FLEET_MACRO_K * len(rec.rows)
+
+
+def test_sharded_macro_digest_true_counts(ser_wd_run, ser_oracles):
+    """The fleet runtime at macro_k=4: run_sharded's per-chunk digest poll
+    still ends on the fleet's true final digest — the fold of the oracle
+    digests plus the pre-halted pad row — and the unpadded state matches
+    the K=1 single-chip run bit-for-bit (macro_k threads through
+    make_sharded_run_fn without touching the poll contract)."""
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+    from librabft_simulator_tpu.parallel import sharded
+
+    assert len(jax.devices()) >= 2, "conftest must force 8 CPU devices"
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    rec = tstream.TimelineRecorder(p=P_MACRO_WD)
+    st = sharded.run_sharded(P_MACRO_WD, mesh2,
+                             byz_fleet_state(P_MACRO_WD, S),
+                             num_steps=FLEET_CHUNK * 200, chunk=FLEET_CHUNK,
+                             stream=rec)
+    last = rec.rows[-1]
+    expect = tstream.fold_digests(
+        [o.digest() for o in ser_oracles] + [tstream.pad_digest()])
+    assert {n: last[n] for n, _ in tstream.DIGEST_SLOTS} == {
+        n: expect[n] for n, _ in tstream.DIGEST_SLOTS}
+    assert last["halted"] == 6
+    # steps metadata: event-steps (chunk * K per dispatched chunk).
+    assert rec.rows[0]["steps"] == FLEET_CHUNK * FLEET_MACRO_K
+    assert_trees_equal(ser_wd_run[0], st)
 
 
 def test_sharded_stream_ends_on_true_final_digest(ser_wd_run, ser_oracles):

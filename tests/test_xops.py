@@ -146,6 +146,32 @@ def test_bool_env_strict(monkeypatch):
         xops.packed_mode()
 
 
+def test_macro_mode_resolution(monkeypatch):
+    """LIBRABFT_MACRO_K: explicit SimParams.macro_k wins, else env, else
+    1 — and malformed/non-positive values raise instead of silently
+    benching the wrong graph (the packed_mode strict-parse discipline)."""
+    from librabft_simulator_tpu.utils import xops
+
+    monkeypatch.delenv(xops.MACRO_ENV, raising=False)
+    assert xops.macro_mode() == 1
+    assert xops.macro_mode(4) == 4
+    monkeypatch.setenv(xops.MACRO_ENV, "16")
+    assert xops.macro_mode() == 16
+    assert xops.macro_mode(2) == 2  # explicit beats env
+    for bad in ("bogus", "0", "-3"):
+        monkeypatch.setenv(xops.MACRO_ENV, bad)
+        with np.testing.assert_raises(ValueError):
+            xops.macro_mode()
+    # resolve_params lands the resolved K in the params (compile key).
+    from librabft_simulator_tpu.core.types import SimParams
+
+    monkeypatch.setenv(xops.MACRO_ENV, "8")
+    assert xops.resolve_params(SimParams()).macro_k == 8
+    assert xops.resolve_params(SimParams(macro_k=2)).macro_k == 2
+    monkeypatch.delenv(xops.MACRO_ENV)
+    assert xops.resolve_params(SimParams()).macro_k == 1
+
+
 def test_scatter_set_bool_and_scalar_src():
     dst = jnp.zeros((10,), jnp.bool_)
     idx = jnp.asarray([1, 9, 10, 4], jnp.int32)
