@@ -17,7 +17,6 @@ import dataclasses
 import json
 import os
 import sys
-import time
 
 import jax
 import numpy as np
@@ -26,6 +25,7 @@ from ..core.types import SimParams
 from ..sim import byzantine as B
 from ..sim import parallel_sim as P
 from ..sim import simulator as S
+from ..telemetry import ledger as tledger
 
 
 def _fleet_stats(p: SimParams, st, elapsed: float) -> dict:
@@ -95,18 +95,20 @@ def run_config(p: SimParams, n_instances: int, seed0: int = 0,
         # RUN_MAX_CHUNKS) so dp and non-dp rows of one sweep run under
         # identical step caps and their stats stay comparable.
         chunk = engine.RUN_CHUNK
-        t0 = time.perf_counter()
-        st = sharded.run_sharded(
-            p, mesh, st, num_steps=chunk * engine.RUN_MAX_CHUNKS,
-            chunk=chunk, engine=engine, stream=stream)
-        # The pipelined loop returns with the last chunk possibly still in
-        # flight; sync before reading the clock or elapsed understates.
-        jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
-        elapsed = time.perf_counter() - t0
+        with tledger.get().span(tledger.RUN, what="sweep_config",
+                                dp=dp) as sp:
+            st = sharded.run_sharded(
+                p, mesh, st, num_steps=chunk * engine.RUN_MAX_CHUNKS,
+                chunk=chunk, engine=engine, stream=stream)
+            # The pipelined loop returns with the last chunk possibly
+            # still in flight; sync before reading the clock or elapsed
+            # understates.
+            jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+        elapsed = sp.dur_s
     else:
-        t0 = time.perf_counter()
-        st = engine.run_to_completion(p, st, batched=True, stream=stream)
-        elapsed = time.perf_counter() - t0
+        with tledger.get().span(tledger.RUN, what="sweep_config") as sp:
+            st = engine.run_to_completion(p, st, batched=True, stream=stream)
+        elapsed = sp.dur_s
     out = _fleet_stats(p, st, elapsed)
     if stream is not None:
         out["stream"] = stream.summary()

@@ -57,6 +57,18 @@ KNOBS: tuple[Knob, ...] = (
          "off (default) leaves the engine graphs untouched.  Mutually "
          "exclusive with stream= (the stream loop is unchecked — "
          "run_to_completion refuses the combination)."),
+    Knob("LIBRABFT_COMPILE_CACHE", "engine", "utils/cache.py",
+         "path|0|off",
+         "The ONE persistent XLA compile-cache directory every entry "
+         "point shares (default /tmp/jax_cache; tier-1, warm_cache.py, "
+         "bench.py and the CLI all hit the same cache).  0/off disables "
+         "persistent caching."),
+    Knob("LIBRABFT_LEDGER_OUT", "engine", "telemetry/ledger.py", "path",
+         "Stream the host-side runtime ledger (compile/dispatch/poll "
+         "spans + compile ledger) as NDJSON to this path, flushed per "
+         "row — readable mid-run (and after a timeout kill) with "
+         "scripts/fleet_watch.py --ledger.  Unset: the ledger stays "
+         "in-memory only."),
     # --- bench.py -------------------------------------------------------
     Knob("BENCH_PLATFORM", "bench", "bench.py", "cpu|tpu",
          "Force the bench backend (skips the tunnel probe)."),
@@ -128,6 +140,11 @@ KNOBS: tuple[Knob, ...] = (
     Knob("BENCH_MACRO_CENSUS", "bench", "bench.py", "0|1",
          "Census fusions-per-event per macro rung (default on; off "
          "skips the second compile per rung)."),
+    Knob("BENCH_LEDGER_OUT", "bench", "bench.py", "path",
+         "RUNTIME_LEDGER artifact path for the fleet ladder (default "
+         "RUNTIME_LEDGER_r12.json): per-rung compile ledger, per-chunk "
+         "dispatch/poll spans, measured pipeline-overlap fraction, and "
+         "the time_to_first_chunk headline."),
     # --- fuzz -----------------------------------------------------------
     Knob("FUZZ_PACKED", "fuzz", "scripts/fuzz_parity.py", "0|1",
          "Run every fuzz trial on the packed-plane engine."),

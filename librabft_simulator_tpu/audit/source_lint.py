@@ -132,9 +132,13 @@ def _s2_in_scope(rel: str) -> bool:
     """Hot-loop modules: the engines, the parallel runtime, in-graph
     telemetry, core protocol, kernels, utils.  Post-run decode modules are
     host-side by design (analysis/, report.py, checkpoint.py, byzantine
-    referees, main.py, oracle/, realnode/)."""
+    referees, main.py, oracle/, realnode/).  telemetry/ledger.py is
+    in scope BY REGISTRATION, not waiver: the runtime ledger wraps the
+    fleet loop's dispatch/poll from the host side and must itself contain
+    zero device syncs — this rule proves that on every lint run."""
     if rel in ("sim/simulator.py", "sim/parallel_sim.py",
-               "telemetry/plane.py", "telemetry/stream.py"):
+               "telemetry/plane.py", "telemetry/stream.py",
+               "telemetry/ledger.py"):
         return True
     return rel.startswith(("core/", "parallel/", "ops/", "utils/"))
 

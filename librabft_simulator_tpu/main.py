@@ -18,7 +18,6 @@ import json
 import os
 import random
 import sys
-import time
 
 import jax
 import numpy as np
@@ -80,10 +79,11 @@ def main(argv=None):
         jax.config.update("jax_platforms", args.platform)
     if not args.no_compile_cache:
         # The jitted step is a large graph (~minutes of XLA time per new
-        # static config); cache compilations across runs.
-        os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # static config); cache compilations across runs — in the one
+        # shared cache (utils/cache.py, LIBRABFT_COMPILE_CACHE).
+        from .utils.cache import setup_compile_cache
+
+        setup_compile_cache()
     seed = args.seed if args.seed is not None else random.getrandbits(32)
     print(f"seed: {seed}", file=sys.stderr)
     trace = 4096 if args.output_data_files else 0
@@ -106,13 +106,16 @@ def main(argv=None):
         trace_cap=trace,
     )
     seeds = (np.uint32(seed) + np.arange(args.instances, dtype=np.uint32))
-    t0 = time.perf_counter()
-    if args.byzantine_f > 0:
-        st = B.init_fault_batch(p, seeds, args.byzantine_f, args.byzantine_kind)
-    else:
-        st = S.init_batch(p, seeds)
-    st = S.run_to_completion(p, st, batched=True)
-    elapsed = time.perf_counter() - t0
+    from .telemetry import ledger as tledger
+
+    with tledger.get().span(tledger.RUN, what="main_cli") as sp:
+        if args.byzantine_f > 0:
+            st = B.init_fault_batch(p, seeds, args.byzantine_f,
+                                    args.byzantine_kind)
+        else:
+            st = S.init_batch(p, seeds)
+        st = S.run_to_completion(p, st, batched=True)
+    elapsed = sp.dur_s
 
     cc = np.asarray(jax.device_get(st.ctx.commit_count))
     print(f"Commands executed per node: {cc.tolist() if args.instances == 1 else cc.mean(axis=0).tolist()}",

@@ -57,6 +57,7 @@ from jax.experimental.shard_map import shard_map
 from ..core import config
 from ..core.types import SimParams
 from ..sim import simulator as sim_ops
+from ..telemetry import ledger as tledger
 from ..telemetry import stream as tstream
 from ..utils import hashing as H
 from ..utils import xops
@@ -204,7 +205,16 @@ def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
     # gamma stay in the key — they parameterize the baked tables.
     key_p = dataclasses.replace(xops.resolve_params(p), max_clock=0,
                                 drop_prob=0.0)
-    return _cached_sharded_run_fn(key_p, mesh, num_steps, eng, wrap)
+    inner = _cached_sharded_run_fn(key_p, mesh, num_steps, eng, wrap)
+    # Compile ledger (telemetry/ledger.py): the sharded chunk executable
+    # is recorded like the single-chip ones — keyed on the normalized
+    # structural params + mesh + shapes, host-side only.
+    return tledger.wrap_compile(
+        inner, key=tledger.params_key(key_p.structural()),
+        structural=repr(key_p.structural()),
+        engine="sharded/" + ("lane" if eng is not sim_ops else "serial"),
+        n_nodes=p.n_nodes, num_steps=num_steps, wrap=wrap,
+        mesh=str(dict(mesh.shape)))
 
 
 @functools.lru_cache(maxsize=None)
@@ -298,30 +308,48 @@ def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
     # per-instance EVENT-steps (each dispatched step retires k events);
     # the digest's own counters are true in-state values regardless.
     k = sim_ops.macro_k_of(xops.resolve_params(p)) if eng is sim_ops else 1
+    # Runtime ledger (telemetry/ledger.py): per-chunk dispatch-enqueue vs
+    # blocking-poll spans, from which pipeline_stats measures the
+    # double-buffered loop's overlap fraction, dispatch-queue bubbles,
+    # and time_to_first_chunk.  Host-side only — the chunk graph and the
+    # one-[D]-fetch poll contract are untouched.
+    lg = tledger.get()
+    rid = lg.new_run("run_sharded", devices=mesh.size, instances=b_total,
+                     pipeline=bool(pipeline), chunk_steps=chunk)
 
-    def poll(dg, done_steps) -> bool:
-        d = _poll_digest(dg)
+    def poll(dg, done_steps, chunk_i) -> bool:
+        with lg.span(tledger.POLL, run=rid, chunk=chunk_i):
+            d = _poll_digest(dg)
         if stream is not None:
             stream.record(d, steps=done_steps * k)
         return int(d[halted_slot]) >= b_total
 
-    state, dg = run(state)
+    with lg.span(tledger.DISPATCH, run=rid, chunk=0):
+        state, dg = run(state)
     done = chunk
     if pipeline:
+        ci = 0
         while done < num_steps:
             lagged = dg
-            state, dg = run(state)  # dispatch k+1 before polling chunk k
+            with lg.span(tledger.DISPATCH, run=rid, chunk=ci + 1):
+                state, dg = run(state)  # dispatch k+1, then poll chunk k
             done += chunk
-            if poll(lagged, done - chunk):
+            if poll(lagged, done - chunk, ci):
+                ci += 1
                 break
-        poll(dg, done)  # the final (possibly in-flight) chunk
+            ci += 1
+        poll(dg, done, ci)  # the final (possibly in-flight) chunk
     else:
+        ci = 0
         while True:
-            if poll(dg, done) or done >= num_steps:
+            if poll(dg, done, ci) or done >= num_steps:
                 break
-            state, dg = run(state)
+            with lg.span(tledger.DISPATCH, run=rid, chunk=ci + 1):
+                state, dg = run(state)
             done += chunk
-    return unpad(state, n_valid)
+            ci += 1
+    with lg.span(tledger.HOST_MERGE, run=rid):
+        return unpad(state, n_valid)
 
 
 # ---------------------------------------------------------------------------

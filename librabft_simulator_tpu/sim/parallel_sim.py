@@ -85,6 +85,7 @@ from ..core.types import (
     sat_add,
     unpack_payload,
 )
+from ..telemetry import ledger as tledger
 from ..telemetry import plane as tplane
 from ..telemetry import stream as tstream
 from ..telemetry.profiling import scope
@@ -942,12 +943,18 @@ def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
     assert 1 <= dmin <= d_min_of(p), (dmin, d_min_of(p))
     p = xops.resolve_params(p)
     _reject_macro(p)
+    ps = p.structural()
     maker = _compiled_digest_run if digest else _compiled_run
-    inner = maker(p.structural(), num_steps, batched)
+    inner = maker(ps, num_steps, batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
     dmin_arr = jnp.asarray(dmin, I32)
-    return lambda st: inner(delay_table, dur_table, dmin_arr, st)
+    # Compile ledger (telemetry/ledger.py): host-side only, same graph.
+    return tledger.wrap_compile(
+        lambda st: inner(delay_table, dur_table, dmin_arr, st),
+        key=tledger.params_key(ps), structural=repr(ps), engine="lane",
+        n_nodes=p.n_nodes, num_steps=num_steps, batched=batched,
+        digest=digest)
 
 
 def init_batch(p: SimParams, seeds) -> PSimState:
@@ -986,8 +993,13 @@ def run_to_completion(p: SimParams, st: PSimState, chunk: int = RUN_CHUNK,
         return sanitize.checked_completion(
             p, st, chunk, max_chunks, batched, _sys.modules[__name__])
     run = make_run_fn(p, chunk, batched=batched)
-    for _ in range(max_chunks):
-        st = run(st)
-        if bool(np.all(jax.device_get(st.halted))):
+    lg = tledger.get()
+    rid = lg.new_run("run_to_completion", engine="lane", chunk_steps=chunk)
+    for i in range(max_chunks):
+        with lg.span(tledger.DISPATCH, run=rid, chunk=i):
+            st = run(st)
+        with lg.span(tledger.POLL, run=rid, chunk=i):
+            halted = jax.device_get(st.halted)
+        if bool(np.all(halted)):
             break
     return st

@@ -47,6 +47,7 @@ from ..core.types import (
     sat_add,
     unpack_payload,
 )
+from ..telemetry import ledger as tledger
 from ..telemetry import plane as tplane
 from ..telemetry import stream as tstream
 from ..telemetry.profiling import scope
@@ -721,11 +722,21 @@ def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
     the chunk boundary, so callers can observe progress with one small
     fetch instead of a [B] plane."""
     p = xops.resolve_params(p)
+    ps = p.structural()
     maker = _compiled_digest_run if digest else _compiled_run
-    inner = maker(p.structural(), num_steps, batched)
+    inner = maker(ps, num_steps, batched)
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
-    return lambda st: inner(delay_table, dur_table, st)
+    # Host-side compile ledger (telemetry/ledger.py): the first call per
+    # argument-shape signature is recorded keyed on the structural params,
+    # with the true backend-compile seconds and the persistent-cache
+    # hit/miss verdict.  Strictly host-side — the traced graph is the
+    # same `inner` either way.
+    return tledger.wrap_compile(
+        lambda st: inner(delay_table, dur_table, st),
+        key=tledger.params_key(ps), structural=repr(ps), engine="serial",
+        n_nodes=p.n_nodes, num_steps=num_steps, batched=batched,
+        digest=digest)
 
 
 def dedupe_buffers(st):
@@ -753,10 +764,14 @@ def stream_completion(run, st, chunk, max_chunks, batched, stream,
     event/commit slots are true in-state counters regardless."""
     b_total = (int(jax.tree_util.tree_leaves(st)[0].shape[0])
                if batched else 1)
+    lg = tledger.get()
+    rid = lg.new_run("stream_completion", chunk_steps=chunk)
     for i in range(max_chunks):
-        st, dg = run(st)
-        d = stream.record(np.asarray(jax.device_get(dg)),
-                          steps=(i + 1) * chunk * events_per_step)
+        with lg.span(tledger.DISPATCH, run=rid, chunk=i):
+            st, dg = run(st)
+        with lg.span(tledger.POLL, run=rid, chunk=i):
+            fetched = np.asarray(jax.device_get(dg))
+        d = stream.record(fetched, steps=(i + 1) * chunk * events_per_step)
         if d["halted"] >= b_total:
             break
     return st
@@ -795,9 +810,13 @@ def run_to_completion(p: SimParams, st: SimState, chunk: int = RUN_CHUNK,
         return sanitize.checked_completion(
             p, st, chunk, max_chunks, batched, _sys.modules[__name__])
     run = make_run_fn(p, chunk, batched=batched)
-    for _ in range(max_chunks):
-        st = run(st)
-        halted = jax.device_get(st.halted)
+    lg = tledger.get()
+    rid = lg.new_run("run_to_completion", engine="serial", chunk_steps=chunk)
+    for i in range(max_chunks):
+        with lg.span(tledger.DISPATCH, run=rid, chunk=i):
+            st = run(st)
+        with lg.span(tledger.POLL, run=rid, chunk=i):
+            halted = jax.device_get(st.halted)
         if np.all(halted):
             break
     return st

@@ -21,4 +21,12 @@ Three pieces, mirroring the split the rest of the codebase uses:
   follows live.  Slot maps are frozen behind ``REGISTRY_VERSION``.
 * :mod:`.profiling` — ``jax.named_scope`` annotations around the step's
   phases so on-chip ``jax.profiler`` traces map to code regions.
+* :mod:`.ledger` — the HOST side of the clock: a process-wide span
+  tracer (compile / dispatch / poll / host_merge) with a compile ledger
+  keyed on ``SimParams.structural()`` + shapes (true backend-compile
+  seconds, persistent-cache hit/miss via ``jax.monitoring``), NDJSON
+  streaming (``LIBRABFT_LEDGER_OUT``; ``fleet_watch.py --ledger``), a
+  Perfetto exporter that overlays the ``librabft/*`` device scopes, and
+  the measured pipeline-overlap / time-to-first-chunk numbers of the
+  double-buffered fleet loop.  Strictly host-only: zero traced ops.
 """

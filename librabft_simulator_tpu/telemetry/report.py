@@ -23,7 +23,6 @@ estimate it, which keeps the report honest about its own resolution.
 from __future__ import annotations
 
 import json
-import time
 from typing import Optional
 
 import jax
@@ -316,22 +315,24 @@ def probe_occupancy(engine, p, B: int = 512, chunk: int = 32,
     ``chunk`` steps over a ``B``-instance fleet and report rates, overflow
     fraction, and — when telemetry is on — the full telemetry block."""
     from ..sim.simulator import dedupe_buffers
+    from . import ledger as tledger
 
     seeds = np.arange(B, dtype=np.uint32)
     st = dedupe_buffers(engine.init_batch(p, seeds))
     run = engine.make_run_fn(p, chunk)
-    t0 = time.perf_counter()
-    st = run(st)
-    jax.block_until_ready(st)
-    compile_s = time.perf_counter() - t0
+    lg = tledger.get()
+    with lg.span(tledger.DISPATCH, what="probe_warmup") as sp_c:
+        st = run(st)
+        jax.block_until_ready(st)
+    compile_s = sp_c.dur_s
     g = lambda x: np.asarray(jax.device_get(x))  # noqa: E731
     e0 = int(g(st.n_events).sum())
     r0 = int((g(st.store.current_round).max(axis=-1) - 1).sum())
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        st = run(st)
-    jax.block_until_ready(st)
-    dt = time.perf_counter() - t0
+    with lg.span(tledger.RUN, what="probe_timed", reps=reps) as sp_t:
+        for _ in range(reps):
+            st = run(st)
+        jax.block_until_ready(st)
+    dt = sp_t.dur_s
     e1 = int(g(st.n_events).sum())
     r1 = int((g(st.store.current_round).max(axis=-1) - 1).sum())
     lost_f = st.n_queue_full if hasattr(st, "n_queue_full") else st.n_inbox_full

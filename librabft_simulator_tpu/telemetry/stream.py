@@ -335,24 +335,24 @@ def load_ndjson(path: str) -> tuple[dict, list[dict]]:
     """Read a stream file back: ``(meta, rows)``.  Refuses (clear error) a
     file written under a different :data:`REGISTRY_VERSION` — the slot maps
     are frozen per version, and decoding across versions would silently
-    misattribute slots."""
-    from . import report
+    misattribute slots.
+
+    Tolerates a truncated FINAL line (the mid-write tail of a run still
+    streaming, or of a timeout-killed writer — ledger.read_ndjson); a
+    corrupt line anywhere else still raises."""
+    from . import ledger, report
 
     meta, rows = None, []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            obj = json.loads(line)
-            if obj.get("kind") == "meta":
-                report.require_registry_version(
-                    obj.get("registry_version"), what=f"stream file {path}")
-                meta = obj
-            else:
-                rows.append(obj)
+    for obj in ledger.read_ndjson(path):
+        if obj.get("kind") == "meta":
+            report.require_registry_version(
+                obj.get("registry_version"), what=f"stream file {path}")
+            meta = obj
+        else:
+            rows.append(obj)
     if meta is None:
         raise ValueError(
             f"stream file {path} has no meta line; not a fleet-stream "
-            "NDJSON artifact (or written by a pre-stream build)")
+            "NDJSON artifact (or written by a pre-stream build, or still "
+            "empty — retry once the run has started)")
     return meta, rows

@@ -1,0 +1,64 @@
+"""Single-source setup for the persistent XLA compilation cache.
+
+Four different hardcoded cache paths had accreted across entry points
+(``/tmp/librabft_tpu_jax_cache`` in main.py/bench.py/tpu_ladder.py,
+``/tmp/jax_cache`` in conftest.py/warm_cache.py/fuzz_parity.py/
+component_profile.py, conditional setup in xplat_parity.py) — so the
+suite and the warm path could compile the SAME executable into two
+different caches and both run cold.  This helper is the one place the
+cache is configured; every entry point calls it, and the
+``LIBRABFT_COMPILE_CACHE`` knob (audit/knobs.py) moves or disables it for
+all of them at once.
+
+The canonical default is ``/tmp/jax_cache`` — the directory tier-1
+(tests/conftest.py) has always used, so existing warmed executables stay
+warm across this consolidation.
+"""
+
+from __future__ import annotations
+
+import os
+
+CACHE_ENV = "LIBRABFT_COMPILE_CACHE"
+
+#: One cache for every entry point: the tier-1 suite, warm_cache.py
+#: children, bench.py, the CLI, and the fuzz/profile scripts all share it.
+DEFAULT_CACHE_DIR = "/tmp/jax_cache"
+
+#: Executables cheaper than this to compile are not worth the disk/serialize
+#: round trip (the same threshold every call site used).
+MIN_COMPILE_TIME_S = 1.0
+
+
+def cache_dir() -> str | None:
+    """The resolved cache directory: ``LIBRABFT_COMPILE_CACHE`` if set (a
+    path), ``None`` if explicitly disabled (``0``/``off``/``none``), else
+    the shared default."""
+    raw = os.environ.get(CACHE_ENV, "").strip()
+    if raw.lower() in ("0", "off", "none", "disabled"):
+        return None
+    return raw or DEFAULT_CACHE_DIR
+
+
+def setup_compile_cache(force: bool = False) -> str | None:
+    """Point jax at the shared persistent compile cache; returns the
+    active directory (``None`` when disabled).
+
+    Idempotent and polite by default: if some earlier code in the process
+    already configured a cache dir (e.g. conftest.py owns it under
+    pytest), ``force=False`` leaves it alone — repointing mid-session
+    would split the session's compiles across two caches, exactly the
+    drift this helper removes."""
+    import jax
+
+    d = cache_dir()
+    if d is None:
+        return None
+    current = jax.config.jax_compilation_cache_dir
+    if current and not force:
+        return current
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      MIN_COMPILE_TIME_S)
+    return d

@@ -14,12 +14,14 @@ import time
 # var itself — no config.update needed).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from librabft_simulator_tpu.utils.cache import setup_compile_cache  # noqa: E402
+
+setup_compile_cache()
 
 import jax.numpy as jnp
 import numpy as np

@@ -9,6 +9,7 @@ that stream observable from ANOTHER terminal while the run is still going:
     python scripts/fleet_watch.py /tmp/fleet.ndjson            # follow live
     python scripts/fleet_watch.py /tmp/fleet.ndjson --once     # print + exit
     python scripts/fleet_watch.py /tmp/fleet.ndjson --summary  # final digest
+    python scripts/fleet_watch.py /tmp/ledger.ndjson --ledger  # host ledger
 
 One line per polled chunk: halt progress (padding-corrected when the
 runner emitted a fleet meta line), events/s, commit/drop/overflow counts,
@@ -16,7 +17,16 @@ queue pressure, round span, ETA — and a loud ``WATCHDOG`` column the
 moment any in-graph detector (liveness stall, queue saturation, sync-jump
 anomaly, safety violation) trips.  Reads are registry-version-checked
 (stream.load_ndjson refuses artifacts from another slot-map version), so
-a stale viewer can never silently misread a newer stream.
+a stale viewer can never silently misread a newer stream.  Partially
+written files are fine: a mid-write trailing line is skipped, and an
+empty/meta-less file exits with a clear message instead of a traceback.
+
+``--ledger`` reads a RUNTIME-LEDGER stream instead (telemetry/ledger.py,
+``LIBRABFT_LEDGER_OUT``): per-chunk dispatch-enqueue vs blocking-poll
+wall time for every recorded host loop, the measured pipeline-overlap
+fraction of the double-buffered dispatch, dispatch-queue bubbles, the
+time-to-first-chunk headline, and the compile ledger (per structural
+key, with persistent-cache hit/miss).
 
 No jax import anywhere: the viewer is pure host-side and starts instantly.
 """
@@ -31,6 +41,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from librabft_simulator_tpu.telemetry import ledger as tledger  # noqa: E402
 from librabft_simulator_tpu.telemetry import report as treport  # noqa: E402
 from librabft_simulator_tpu.telemetry import stream as tstream  # noqa: E402
 
@@ -119,6 +130,57 @@ def follow(path: str, view: _View, poll_s: float = 0.5,
                 time.sleep(poll_s)
 
 
+def show_ledger(path: str, out=None) -> int:
+    """The --ledger view: per-chunk dispatch/poll wall time for every
+    recorded host loop, the measured overlap fraction + bubbles of the
+    double-buffered dispatch, time_to_first_chunk, and the compile
+    ledger (key, shapes, compile seconds, persistent-cache verdict)."""
+    out = out if out is not None else sys.stdout  # late-bound: capturable
+    meta, rows = tledger.load_ndjson(path)
+    run_meta = {r["run"]: r for r in rows if r.get("kind") == "run"}
+    runs = sorted(run_meta) or sorted(
+        {r["run"] for r in rows
+         if r.get("kind") == "span" and r.get("run") is not None})
+    printed = False
+    for rid in runs:
+        pipe = tledger.pipeline_stats(rows, run=rid)
+        if not pipe["chunks"]:
+            continue
+        printed = True
+        rm = run_meta.get(rid, {})
+        # Overlap is only meaningful for a double-buffered loop (the run
+        # row says pipeline=True); a serial completion loop polls the
+        # chunk it just dispatched, so its ~1.0 would be a lie.
+        overlap = (pipe["overlap_fraction"] if rm.get("pipeline")
+                   else "n/a (not double-buffered)")
+        print(f"# run {rid} ({rm.get('label', '?')}): "
+              f"chunks={pipe['chunks']} "
+              f"overlap={overlap} "
+              f"bubbles={pipe['bubble_count']} "
+              f"time_to_first_chunk={pipe.get('time_to_first_chunk_s')}s",
+              file=out)
+        print(f"{'chunk':>5} {'dispatch_ms':>12} {'poll_ms':>9}  note",
+              file=out)
+        for row in pipe["rows"]:
+            note = "bubble" if row["chunk"] in pipe["bubbles"] else (
+                "cold (compile)" if row["chunk"] == 0 else "")
+            print(f"{row['chunk']:>5} {row['dispatch_s'] * 1e3:>12.2f} "
+                  f"{row['poll_s'] * 1e3:>9.2f}  {note}", file=out)
+    compiles = [r for r in rows if r.get("kind") == "compile"]
+    if compiles:
+        printed = True
+        print(f"# compile ledger: {len(compiles)} builds", file=out)
+        for e in compiles:
+            print(f"  {e.get('key')} {e.get('engine', '?'):>14} "
+                  f"shapes={e.get('shapes')} {e.get('cache')} "
+                  f"compile_s={e.get('compile_s', 0):.2f} "
+                  f"first_call_s={e.get('first_call_s', 0):.2f}", file=out)
+    if not printed:
+        print("no ledger rows yet", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("path", help="NDJSON stream file (TimelineRecorder out=)")
@@ -126,36 +188,50 @@ def main(argv=None) -> int:
                     help="print what's in the file now and exit")
     ap.add_argument("--summary", action="store_true",
                     help="print only the final digest as JSON and exit")
+    ap.add_argument("--ledger", action="store_true",
+                    help="the file is a runtime-ledger stream "
+                         "(LIBRABFT_LEDGER_OUT): print per-chunk "
+                         "dispatch/poll timing, overlap, bubbles, and "
+                         "the compile ledger")
     ap.add_argument("--poll", type=float, default=0.5,
                     help="follow-mode poll interval in seconds")
     ap.add_argument("--idle-timeout", type=float, default=None,
                     help="stop following after this many idle seconds")
     args = ap.parse_args(argv)
 
-    if args.summary:
-        meta, rows = tstream.load_ndjson(args.path)
-        data = [r for r in rows if r.get("kind") == "row"]
-        if not data:
-            print("no rows yet", file=sys.stderr)
-            return 1
-        last = data[-1]
-        print(json.dumps({
-            "chunks": len(data), "elapsed_s": last["t_s"],
-            "final": {n: last[n] for n, _ in tstream.DIGEST_SLOTS},
-            "watchdog_flags": last["watchdog_flags"],
-            "watchdog": _flag_names(last["watchdog_flags"]),
-        }, indent=1))
-        return 0
+    try:
+        if args.ledger:
+            return show_ledger(args.path)
 
-    view = _View()
-    if args.once:
-        meta, rows = tstream.load_ndjson(args.path)
-        view.feed(dict(meta, kind="meta"))
-        for r in rows:
-            view.feed(r)
-        return 0
-    follow(args.path, view, poll_s=args.poll,
-           idle_timeout_s=args.idle_timeout)
+        if args.summary:
+            meta, rows = tstream.load_ndjson(args.path)
+            data = [r for r in rows if r.get("kind") == "row"]
+            if not data:
+                print("no rows yet", file=sys.stderr)
+                return 1
+            last = data[-1]
+            print(json.dumps({
+                "chunks": len(data), "elapsed_s": last["t_s"],
+                "final": {n: last[n] for n, _ in tstream.DIGEST_SLOTS},
+                "watchdog_flags": last["watchdog_flags"],
+                "watchdog": _flag_names(last["watchdog_flags"]),
+            }, indent=1))
+            return 0
+
+        view = _View()
+        if args.once:
+            meta, rows = tstream.load_ndjson(args.path)
+            view.feed(dict(meta, kind="meta"))
+            for r in rows:
+                view.feed(r)
+            return 0
+        follow(args.path, view, poll_s=args.poll,
+               idle_timeout_s=args.idle_timeout)
+    except (OSError, ValueError) as e:
+        # An empty, still-initializing, or foreign file is an operator
+        # answer ("nothing to show yet / wrong file"), not a traceback.
+        print(f"fleet_watch: {e}", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -43,8 +43,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from librabft_simulator_tpu.utils.cache import setup_compile_cache  # noqa: E402
+
+setup_compile_cache()
 
 import numpy as np  # noqa: E402
 

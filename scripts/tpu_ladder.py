@@ -25,9 +25,9 @@ raise_stack_limit()
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
-os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
-jax.config.update("jax_compilation_cache_dir", "/tmp/librabft_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from librabft_simulator_tpu.utils.cache import setup_compile_cache  # noqa: E402
+
+setup_compile_cache()
 
 
 def rung(engine_name: str, batch: int, chunk: int, reps: int) -> dict:

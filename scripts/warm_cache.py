@@ -114,11 +114,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import sys, json
 import numpy as np
 sys.path.insert(0, %(root)r)
+from librabft_simulator_tpu.telemetry import ledger as tledger
+from librabft_simulator_tpu.utils.cache import setup_compile_cache
+setup_compile_cache()
 from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.sim import parallel_sim, simulator
 from librabft_simulator_tpu.sim.simulator import dedupe_buffers
@@ -141,6 +142,13 @@ if kw.get("watchdog") and batch is not None:
     st, _ = engine.make_run_fn(p, chunk, digest=True)(st)
 jax.block_until_ready(st)
 print("warmed", engine_name, kw, batch)
+# The runtime ledger saw every build: say whether this shape actually
+# warmed (persistent-miss = the compile this run exists to pre-pay) or
+# was already warm — so a broken shared cache shows up HERE, not as a
+# mystery tier-1 dot regression.
+for e in tledger.get().compiles:
+    print("  compile", e["key"], e["shapes"], e["cache"],
+          "compile_s=%%.1f" %% e["compile_s"])
 """
 
 
@@ -149,11 +157,11 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import sys, json
 import numpy as np
 sys.path.insert(0, %(root)r)
+from librabft_simulator_tpu.utils.cache import setup_compile_cache
+setup_compile_cache()
 from librabft_simulator_tpu.audit import sanitize
 from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.sim import parallel_sim, simulator
@@ -177,10 +185,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import sys, json
 sys.path.insert(0, %(root)r)
+from librabft_simulator_tpu.telemetry import ledger as tledger
+from librabft_simulator_tpu.utils.cache import setup_compile_cache
+setup_compile_cache()
 from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.parallel import mesh as mesh_ops, sharded
 from librabft_simulator_tpu.sim import parallel_sim, simulator
@@ -194,6 +203,9 @@ st = sharded.run_sharded(p, mesh, st, num_steps=chunk, chunk=chunk,
                          engine=engine)
 jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
 print("warmed sharded", engine_name, kw, batch, "dp", dp)
+for e in tledger.get().compiles:
+    print("  compile", e["key"], e["shapes"], e["cache"],
+          "compile_s=%%.1f" %% e["compile_s"])
 """
 
 

@@ -39,14 +39,13 @@ def _setup_process():
     cache config is also applied only when nothing configured one yet —
     under pytest, conftest.py already owns it and run_check must not
     repoint the rest of the session."""
+    from librabft_simulator_tpu.utils.cache import setup_compile_cache
     from librabft_simulator_tpu.utils.rlimit import raise_stack_limit
 
     raise_stack_limit()
-    if jax.config.jax_compilation_cache_dir is None:
-        os.makedirs("/tmp/librabft_tpu_jax_cache", exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/librabft_tpu_jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # force=False: under pytest, conftest.py already owns the cache config
+    # and run_check must not repoint the rest of the session.
+    setup_compile_cache()
 
 
 def run_check(engine_name: str = "serial", batch: int = 2048,

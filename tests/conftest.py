@@ -73,8 +73,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: repeat test runs skip XLA recompiles.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# Single-sourced (utils/cache.py) so the suite, warm_cache.py, bench.py
+# and the CLI all share ONE cache (LIBRABFT_COMPILE_CACHE moves it).
+from librabft_simulator_tpu.utils.cache import setup_compile_cache  # noqa: E402
+
+setup_compile_cache()
 
 
 def pytest_runtest_teardown(item, nextitem):

@@ -408,6 +408,35 @@ class TestSanitizer:
 # ---------------------------------------------------------------------------
 
 
+def test_ledger_on_off_lowering_identical():
+    """The runtime ledger (telemetry/ledger.py) is host-only BY
+    CONSTRUCTION — prove it, don't assert it: both engines' chunk scans
+    trace to eqn-identical jaxprs with the process ledger enabled and
+    disabled.  Spans and compile attribution wrap the host call around
+    the executable; nothing of the ledger may ever enter the traced
+    graph (zero added fusions, census budgets and audit signatures
+    unchanged)."""
+    from librabft_simulator_tpu.telemetry import ledger as tledger
+
+    lg = tledger.get()
+    prev = lg.enabled
+
+    def sig(engine, kw):
+        p = SimParams(max_clock=100, **kw)
+        st = engine.init_batch(p, np.arange(2, dtype=np.uint32))
+        cj = jax.make_jaxpr(engine.make_scan_fn(p, 2))(st)
+        return GL.eqn_signature(cj.jaxpr)
+
+    try:
+        lg.enabled = True
+        on = [sig(S, GL.MICRO_SER_KW), sig(PE, GL.MICRO_LANE_KW)]
+        lg.enabled = False
+        off = [sig(S, GL.MICRO_SER_KW), sig(PE, GL.MICRO_LANE_KW)]
+    finally:
+        lg.enabled = prev
+    assert on == off
+
+
 def test_r6_detects_feedback():
     """A graph where the 'telemetry' value DOES feed consensus must NOT
     compare equal under the R6 DCE construction."""
