@@ -601,7 +601,7 @@ def _write_runtime_ledger(rows, fleet_artifact: str) -> None:
     if not led:
         return
     head = next((r for r in led if r["dp"] == 1), led[0])
-    path = os.environ.get("BENCH_LEDGER_OUT", "RUNTIME_LEDGER_r12.json")
+    path = os.environ.get("BENCH_LEDGER_OUT", "RUNTIME_LEDGER_r13.json")
     art = {
         "kind": "runtime_ledger",
         "ledger_version": tledger.LEDGER_VERSION,
@@ -610,9 +610,16 @@ def _write_runtime_ledger(rows, fleet_artifact: str) -> None:
         "fleet_artifact": fleet_artifact,
         "time_to_first_chunk_s": head["ledger"]["time_to_first_chunk_s"],
         "time_to_first_chunk_dp": head["dp"],
+        "ttfc_aot_s": head["ledger"].get("ttfc_aot"),
+        "ttfc_jit_s": head["ledger"].get("ttfc_jit"),
         "note": "time_to_first_chunk = first dispatch enqueue to the first "
                 "chunk's [D] digest on host, XLA compile included "
-                "(jax/backend import excluded); overlap_fraction = "
+                "(jax/backend import excluded); ttfc_aot/ttfc_jit = the "
+                "same number from the per-rung cold-process A/B — the "
+                "production path consulting the AOT executable store "
+                "(utils/aot.py; compile verdicts say aot-hit when it "
+                "loaded) vs LIBRABFT_AOT=0 (trace+lower+compile, "
+                "persistent cache verdicts apply); overlap_fraction = "
                 "poll_s/(poll_s+dispatch_s) over steady-state chunks of "
                 "the double-buffered loop (~1.0 device-bound = dispatch "
                 "fully hidden, ~0 host-bound); bubbles = chunks whose "
@@ -627,9 +634,11 @@ def _write_runtime_ledger(rows, fleet_artifact: str) -> None:
     }
     with open(path, "w") as f:
         json.dump(art, f, indent=1)
+    ab = (f"; aot={art['ttfc_aot_s']}s jit={art['ttfc_jit_s']}s"
+          if art["ttfc_aot_s"] is not None else "")  # A/B may be skipped
     print(f"bench: wrote runtime-ledger artifact {path} "
           f"(time_to_first_chunk={art['time_to_first_chunk_s']}s at "
-          f"dp={head['dp']})", file=sys.stderr)
+          f"dp={head['dp']}{ab})", file=sys.stderr)
 
 
 def run_fleet_ladder(out_path: str) -> dict:
@@ -644,25 +653,54 @@ def run_fleet_ladder(out_path: str) -> dict:
     base_flags = " ".join(
         f for f in os.environ.get("XLA_FLAGS", "").split()
         if "xla_force_host_platform_device_count" not in f)
-    rows, failures = [], {}
-    for dp in rungs:
+    # AOT A/B (default on): each rung runs twice in cold processes — once
+    # on the production path (AOT store consulted; ttfc_aot) and once
+    # with LIBRABFT_AOT=0 (pure jit/persistent-cache path; ttfc_jit) —
+    # so RUNTIME_LEDGER lands the measured compile-tax delta per rung
+    # with the compile-ledger verdicts saying exactly what each leg paid
+    # (aot-hit vs persistent-hit/miss).  BENCH_FLEET_AOT_AB=0 skips the
+    # jit leg.
+    from librabft_simulator_tpu.utils.xops import _bool_env
+
+    aot_ab = _bool_env("BENCH_FLEET_AOT_AB") is not False
+
+    def run_child(dp: int, aot_off: bool):
         env = dict(os.environ, BENCH_PLATFORM="cpu",
                    BENCH_FLEET_CHILD=str(dp),
                    XLA_FLAGS=(base_flags +
                               f" --xla_force_host_platform_device_count={dp}"
                               ).strip())
         env.pop("BENCH_FLEET", None)
+        if aot_off:
+            env["LIBRABFT_AOT"] = "0"
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            env=env, capture_output=True, text=True)
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
         try:
-            row = json.loads(line)
+            return json.loads(line), None
         except ValueError:
-            failures[dp] = (f"rc={r.returncode}: "
-                            f"{(r.stderr or line)[-300:]}")
-            print(f"bench: fleet rung dp={dp} failed ({failures[dp][:120]})",
+            return None, f"rc={r.returncode}: {(r.stderr or line)[-300:]}"
+
+    rows, failures = [], {}
+    for dp in rungs:
+        row, err = run_child(dp, aot_off=False)
+        if row is None:
+            failures[dp] = err
+            print(f"bench: fleet rung dp={dp} failed ({err[:120]})",
                   file=sys.stderr)
             continue
+        if aot_ab:
+            ledger = row.setdefault("ledger", {})
+            ledger["ttfc_aot"] = ledger.get("time_to_first_chunk_s")
+            row_b, err_b = run_child(dp, aot_off=True)
+            if row_b is None:
+                print(f"bench: fleet rung dp={dp} jit A/B leg failed "
+                      f"({(err_b or '')[:120]})", file=sys.stderr)
+                ledger["ttfc_jit"] = None
+            else:
+                lb = row_b.get("ledger") or {}
+                ledger["ttfc_jit"] = lb.get("time_to_first_chunk_s")
+                ledger["ttfc_jit_compiles"] = lb.get("compiles")
         rows.append(row)
         print(json.dumps(row), file=sys.stderr, flush=True)
     base = next((r["events_per_sec"] for r in rows if r["dp"] == 1), None)

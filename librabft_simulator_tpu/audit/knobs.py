@@ -69,6 +69,23 @@ KNOBS: tuple[Knob, ...] = (
          "row — readable mid-run (and after a timeout kill) with "
          "scripts/fleet_watch.py --ledger.  Unset: the ledger stays "
          "in-memory only."),
+    Knob("LIBRABFT_AOT", "engine", "utils/aot.py", "0|1",
+         "Consult the AOT executable store before tracing (default on): "
+         "make_run_fn / make_sharded_run_fn / the sanitizer build load a "
+         "ready serialized executable on a store hit (ledger verdict "
+         "aot-hit) and fall back to the untouched jit path on any miss, "
+         "staleness, or load error.  0 = provably inert pass-through."),
+    Knob("LIBRABFT_AOT_DIR", "engine", "utils/aot.py", "path",
+         "The AOT store directory (default /tmp/librabft_aot): a "
+         "relocatable artifact dir of serialized executables + sidecars "
+         "+ manifest.json, built by scripts/warm_cache.py and listed by "
+         "python -m librabft_simulator_tpu.utils.aot --list."),
+    Knob("LIBRABFT_AOT_WRITE", "engine", "utils/aot.py", "0|1",
+         "Export freshly compiled chunk executables back into the AOT "
+         "store on a miss (default off; warm_cache children set it). "
+         "The export compile bypasses the persistent XLA cache (a "
+         "cache-hydrated executable re-serializes broken) and the "
+         "written artifact is verified by loading it back."),
     # --- bench.py -------------------------------------------------------
     Knob("BENCH_PLATFORM", "bench", "bench.py", "cpu|tpu",
          "Force the bench backend (skips the tunnel probe)."),
@@ -142,9 +159,15 @@ KNOBS: tuple[Knob, ...] = (
          "skips the second compile per rung)."),
     Knob("BENCH_LEDGER_OUT", "bench", "bench.py", "path",
          "RUNTIME_LEDGER artifact path for the fleet ladder (default "
-         "RUNTIME_LEDGER_r12.json): per-rung compile ledger, per-chunk "
+         "RUNTIME_LEDGER_r13.json): per-rung compile ledger, per-chunk "
          "dispatch/poll spans, measured pipeline-overlap fraction, and "
-         "the time_to_first_chunk headline."),
+         "the time_to_first_chunk headline with the ttfc_aot/ttfc_jit "
+         "A/B."),
+    Knob("BENCH_FLEET_AOT_AB", "bench", "bench.py", "0|1",
+         "Per-rung AOT A/B in the fleet ladder (default on): each dp "
+         "rung runs a second cold process with LIBRABFT_AOT=0, landing "
+         "ttfc_aot (store-loaded) vs ttfc_jit (trace+lower+compile) in "
+         "the RUNTIME_LEDGER artifact.  0 = production leg only."),
     # --- fuzz -----------------------------------------------------------
     Knob("FUZZ_PACKED", "fuzz", "scripts/fuzz_parity.py", "0|1",
          "Run every fuzz trial on the packed-plane engine."),

@@ -124,7 +124,31 @@ def _cached_checked_run(p_structural: SimParams, num_steps: int,
         return st
 
     errors = checkify.user_checks | checkify.div_checks
-    return jax.jit(checkify.checkify(checked, errors=errors))
+    jit_fn = jax.jit(checkify.checkify(checked, errors=errors))
+    # AOT executable store (utils/aot.py): the checkify build is its own
+    # heavy executable (error plumbing wraps the whole scan) with tables
+    # baked into the scan closure — keyed on the FULL resolved params,
+    # like the sharded runner.  warm_cache's SANITIZE_SHAPES children
+    # export it; tier-1's sanitizer smoke then loads instead of
+    # re-deriving.  Wrapped inside this lru cache so repeated
+    # make_checked_run_fn calls share one consult/load.
+    from ..telemetry import ledger as tledger
+    from ..utils import aot
+
+    call = aot.wrap_jit(
+        jit_fn, (), key=tledger.params_key(p_structural),
+        engine=engine_name, flavor="sanitize", num_steps=num_steps,
+        batched=batched)
+    # Compile ledger: the checkify build records like the engines', so
+    # the store's verdicts (aot-hit/aot-stale/aot-export) land on a real
+    # entry instead of vanishing (annotate_compile is a no-op outside an
+    # attribution block).  The "sanitize/" engine prefix keeps these rows
+    # out of warm_cache --from-ledger, which rebuilds engine chunks only.
+    return tledger.wrap_compile(
+        call, key=tledger.params_key(p_structural),
+        structural=repr(p_structural),
+        engine="sanitize/" + engine_name,
+        n_nodes=p_structural.n_nodes, num_steps=num_steps, batched=batched)
 
 
 def make_checked_run_fn(p: SimParams, num_steps: int, batched: bool = True,

@@ -59,6 +59,7 @@ from ..core.types import SimParams
 from ..sim import simulator as sim_ops
 from ..telemetry import ledger as tledger
 from ..telemetry import stream as tstream
+from ..utils import aot
 from ..utils import hashing as H
 from ..utils import xops
 from . import mesh as mesh_ops
@@ -206,13 +207,24 @@ def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
     key_p = dataclasses.replace(xops.resolve_params(p), max_clock=0,
                                 drop_prob=0.0)
     inner = _cached_sharded_run_fn(key_p, mesh, num_steps, eng, wrap)
+    eng_name = "sharded/" + ("lane" if eng is not sim_ops else "serial")
+    # AOT executable store (utils/aot.py): consult before tracing — see
+    # simulator.make_run_fn.  Unlike the single-chip runners, the delay/
+    # duration tables are BAKED into the sharded scan closure, so the
+    # store key must carry the full normalized params (key_p), not just
+    # structural() — two delay configs are two different executables
+    # here.  Mesh layout and wrap mode complete the key.
+    call = aot.wrap_jit(
+        inner, (), key=tledger.params_key(key_p), engine=eng_name,
+        flavor="digest", num_steps=num_steps, wrap=wrap,
+        mesh=str(dict(mesh.shape)))
     # Compile ledger (telemetry/ledger.py): the sharded chunk executable
     # is recorded like the single-chip ones — keyed on the normalized
     # structural params + mesh + shapes, host-side only.
     return tledger.wrap_compile(
-        inner, key=tledger.params_key(key_p.structural()),
+        call, key=tledger.params_key(key_p.structural()),
         structural=repr(key_p.structural()),
-        engine="sharded/" + ("lane" if eng is not sim_ops else "serial"),
+        engine=eng_name,
         n_nodes=p.n_nodes, num_steps=num_steps, wrap=wrap,
         mesh=str(dict(mesh.shape)))
 

@@ -89,6 +89,7 @@ from ..telemetry import ledger as tledger
 from ..telemetry import plane as tplane
 from ..telemetry import stream as tstream
 from ..telemetry.profiling import scope
+from ..utils import aot
 from ..utils import hashing as H
 from ..utils import xops
 from ..utils.xops import wset
@@ -949,9 +950,18 @@ def make_run_fn(p: SimParams, num_steps: int, batched: bool = True,
     delay_table = jnp.asarray(p.delay_table())
     dur_table = jnp.asarray(p.duration_table())
     dmin_arr = jnp.asarray(dmin, I32)
+    # AOT executable store (utils/aot.py): consult before tracing — see
+    # simulator.make_run_fn.  Tables and the lookahead scalar are
+    # arguments of the stored executable, so one entry serves every
+    # delay/drop/d_min config at this structural shape.
+    call = aot.wrap_jit(
+        inner, (delay_table, dur_table, dmin_arr),
+        key=tledger.params_key(ps), engine="lane",
+        flavor="digest" if digest else "run",
+        num_steps=num_steps, batched=batched)
     # Compile ledger (telemetry/ledger.py): host-side only, same graph.
     return tledger.wrap_compile(
-        lambda st: inner(delay_table, dur_table, dmin_arr, st),
+        call,
         key=tledger.params_key(ps), structural=repr(ps), engine="lane",
         n_nodes=p.n_nodes, num_steps=num_steps, batched=batched,
         digest=digest)

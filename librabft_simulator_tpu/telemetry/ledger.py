@@ -277,6 +277,16 @@ class RuntimeLedger:
                 tally[0] += 1
                 tally[1] += float(dur)
 
+    def annotate_compile(self, **kw) -> None:
+        """Merge fields into the compile-ledger entry currently being
+        attributed on this thread (no-op outside an attribution block).
+        The AOT store (utils/aot.py) uses this to land its verdict —
+        ``_aot='hit'/'stale'`` plus ``aot_load_s`` — on the entry the
+        enclosing :meth:`compile_attribution` will classify."""
+        ctx = self._compile_ctx()
+        if ctx is not None:
+            ctx.update(kw)
+
     @contextlib.contextmanager
     def compile_attribution(self, key: str, **meta):
         """Attribute every compile-class jax.monitoring event fired on
@@ -300,11 +310,36 @@ class RuntimeLedger:
             if entry["cache_hits"] and not entry["cache_misses"]:
                 entry["cache"] = "persistent-hit"
             elif entry["cache_misses"]:
-                entry["cache"] = "persistent-miss"
+                # A jaxlib/XLA upgrade invalidates every persistent-cache
+                # entry by construction (compiler version is in the cache
+                # key); the cache-dir stamp (utils/cache.py) makes that a
+                # distinguishable verdict instead of a mystery cold run.
+                from ..utils import cache as _cache
+
+                entry["cache"] = ("stale-toolchain"
+                                  if _cache.stale_toolchain() is not None
+                                  else "persistent-miss")
             elif entry["compile_s"] > 0:
                 entry["cache"] = "uncached"      # no persistent cache set up
             else:
                 entry["cache"] = "memory"        # in-process executable reuse
+            # AOT-store verdicts (utils/aot.py, via annotate_compile)
+            # override: an aot-hit paid NO trace/lower/compile at all —
+            # the entry's only cost is aot_load_s — and an aot-stale
+            # entry fell back to whatever the base verdict says (kept in
+            # ``fallback`` so the staleness is loud but the real cost
+            # attribution survives).
+            aot_note = entry.pop("_aot", None)
+            if aot_note == "hit":
+                entry["cache"] = "aot-hit"
+            elif aot_note == "stale":
+                entry["fallback"] = entry["cache"]
+                entry["cache"] = "aot-stale"
+            elif aot_note == "export":
+                # Build step: a deliberate full fresh compile (the
+                # persistent cache is bypassed — see utils/aot._export),
+                # serialized into the store.
+                entry["cache"] = "aot-export"
             if self.enabled:
                 with self._lock:
                     self.compiles.append(entry)
@@ -355,6 +390,7 @@ class RuntimeLedger:
                 "hits": sum(e["cache_hits"] for e in self.compiles),
                 "misses": sum(e["cache_misses"] for e in self.compiles),
             },
+            "aot": _aot_tally(self.compiles),
             "unattributed": {k: {"count": v[0], "total_s": round(v[1], 6)}
                              for k, v in self.unattributed.items()},
         }
@@ -390,6 +426,16 @@ class RuntimeLedger:
             with open(path, "w") as f:
                 json.dump(doc, f)
         return doc
+
+
+def _aot_tally(compiles) -> dict:
+    """AOT-store verdict counts + total load seconds over compile-ledger
+    entries (utils/aot.py wrote the fields; pure row math, jax-free)."""
+    return {
+        "hits": sum(1 for e in compiles if e.get("cache") == "aot-hit"),
+        "stale": sum(1 for e in compiles if e.get("cache") == "aot-stale"),
+        "load_s": round(sum(e.get("aot_load_s", 0.0) for e in compiles), 3),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -554,6 +600,7 @@ def compile_attribution_summary(rows, top: int = 10) -> dict:
                 "hits": sum(e.get("cache_hits", 0) for e in compiles),
                 "misses": sum(e.get("cache_misses", 0) for e in compiles),
             },
+            "aot": _aot_tally(compiles),
             "top": ranked[:top],
         },
         "spans": span_totals,

@@ -1,0 +1,599 @@
+"""Ahead-of-time executable store: kill the compile tax at cold start.
+
+Every host-side bottleneck left in this repo is XLA compilation, not
+simulation: the round-12 runtime ledger pins fleet time-to-first-chunk at
+42.1 s cold (32.6 s backend compile) vs 9.7 s with the persistent compile
+cache — still 2x over the ROADMAP's < 5 s target, because a persistent-
+cache hit re-pays trace + lower + cache retrieval every process.  But the
+staged trace->lower->compile pipeline makes a compiled executable a pure
+function of ``(structural params, argument shapes, backend, toolchain)``
+— exactly the key the compile ledger (telemetry/ledger.py) already
+records — so it can be built ONCE and shipped like any other build
+product.  This module is that build product's store:
+
+* **Entries** are ``jax.experimental.serialize_executable`` payloads
+  (the XLA serialized executable + calling-convention pytrees) written
+  as ``<store_key>.bin`` + a ``<store_key>.json`` sidecar (engine,
+  flavor, structural key, shapes, compile seconds, toolchain stamp),
+  aggregated into a ``manifest.json`` under an fcntl lock.  The
+  directory is relocatable: build it on one container, ship it, point
+  ``LIBRABFT_AOT_DIR`` at it on another with the same toolchain.
+* **Keying**: ``store_key = sha1(params_key(SimParams.structural()),
+  flavor meta (engine / digest / num_steps / mesh / wrap), argument
+  aval signature, backend platform, device count)``.  The toolchain
+  stamp (jax + jaxlib versions, utils/cache.py) is checked at LOAD
+  time, not hashed into the key, so a foreign-toolchain entry is
+  reported as ``aot-stale`` in the compile ledger instead of silently
+  missing — the failure mode the round-11 re-baseline hit with the
+  bare persistent cache.
+* **Consult-before-trace** (:func:`wrap_jit`): the engines'
+  ``make_run_fn`` / ``make_sharded_run_fn`` (and the checkify
+  sanitizer build) route their jitted chunk through this wrapper.  On
+  the first call per argument-shape signature it consults the store: a
+  hit deserializes a ready executable (no trace, no lower, no XLA
+  compile — recorded as ``aot-hit`` with the true load seconds) and a
+  miss, version skew, corrupt file, or any load error falls back to
+  the existing jit path UNTOUCHED (never a crash).  With
+  ``LIBRABFT_AOT_WRITE=1`` a miss additionally exports the freshly
+  compiled executable back into the store (``scripts/warm_cache.py``
+  children are the build step; test suites never write).
+* **Inertness**: ``LIBRABFT_AOT=0`` makes the wrapper a transparent
+  pass-through to the exact jit callable — no store I/O, no graph
+  difference (there is none either way: the store is strictly
+  host-side dispatch plumbing; census budgets and graph-audit
+  signatures are pinned unchanged by tests/test_aot.py).
+
+Like telemetry/ledger.py, this module is in the source-lint S2 hot-loop
+scope by registration: it wraps the fleet loop's dispatch entry and must
+itself contain zero device syncs (deserialization is host work; the
+loaded executable dispatches exactly like the jit one).
+
+CLI (no jax import — safe anywhere)::
+
+    python -m librabft_simulator_tpu.utils.aot --list [--dir DIR]
+
+prints the manifest: every stored executable with engine, flavor,
+shapes, compile seconds and toolchain stamp.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import sys
+import threading
+import time
+
+from . import cache as _cache
+
+#: Env knob: 0/off disables consulting the store entirely (the wrapper
+#: becomes a transparent pass-through to the jit path).  Default on.
+AOT_ENV = "LIBRABFT_AOT"
+
+#: Env knob: the store directory (relocatable artifact).  Default below.
+DIR_ENV = "LIBRABFT_AOT_DIR"
+
+#: Env knob: 1 = export freshly compiled executables back into the store
+#: on a miss (the warm_cache build-step children set this; suites never
+#: write — serialize() in a long-running many-compile process risks the
+#: jaxlib segfault warm_cache's docstring describes).
+WRITE_ENV = "LIBRABFT_AOT_WRITE"
+
+#: One store for every entry point, mirroring utils/cache.py's shared
+#: persistent-cache default: warm_cache children write here and tier-1 /
+#: bench / the CLI load from here unless LIBRABFT_AOT_DIR moves it.
+DEFAULT_AOT_DIR = "/tmp/librabft_aot"
+
+#: Store schema version: bumped when the entry payload or sidecar layout
+#: changes; foreign versions are refused at load (clean jit fallback).
+AOT_VERSION = 1
+
+_lock = threading.Lock()
+#: (store dir, store_key) -> loaded executable callable; one deserialize
+#: per process however many wrappers consult the same entry.  Keyed by
+#: dir as well so repointing LIBRABFT_AOT_DIR mid-process (tests, tools)
+#: can never serve an executable from the previous store.
+_LOADED: dict = {}
+#: (store dir, store_key) -> verdict string for keys already probed and
+#: not loadable ("aot-stale" / "aot-error" / "aot-miss"): saves repeated
+#: disk probes.
+_REFUSED: dict = {}
+
+
+def _bool_knob(env: str, default: bool) -> bool:
+    """Strict boolean env parse (the xops._bool_env contract, restated
+    here jax-free for the CLI): unrecognized values raise instead of
+    silently picking a side — LIBRABFT_AOT=of must not mean 'on'."""
+    val = os.environ.get(env, "").strip().lower()
+    if not val:
+        return default
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(f"{env}={val!r}: want one of 1/0, true/false, "
+                     f"yes/no, on/off")
+
+
+def enabled() -> bool:
+    """Whether the store is consulted at all (``LIBRABFT_AOT``; default
+    on — a missing/empty store is just a miss, so on is always safe)."""
+    return _bool_knob(AOT_ENV, True)
+
+
+def write_enabled() -> bool:
+    """Whether misses export back into the store (``LIBRABFT_AOT_WRITE``;
+    default off)."""
+    return _bool_knob(WRITE_ENV, False)
+
+
+def store_dir() -> str:
+    return os.environ.get(DIR_ENV, "").strip() or DEFAULT_AOT_DIR
+
+
+def reset_cache() -> None:
+    """Drop the in-process load/refusal caches (tests: re-probe a store
+    this process already consulted)."""
+    with _lock:
+        _LOADED.clear()
+        _REFUSED.clear()
+
+
+# ---------------------------------------------------------------------------
+# Keying.
+# ---------------------------------------------------------------------------
+
+
+def _avals(leaves) -> tuple:
+    """Hashable (shape, dtype) tuple per leaf — the cheap per-dispatch
+    identity the wrapper memoizes on (no repr, no sha1)."""
+    return tuple((tuple(getattr(l, "shape", ())),
+                  str(getattr(l, "dtype", type(l).__name__)))
+                 for l in leaves)
+
+
+def _sig_of(avals: tuple, treedef) -> str:
+    """The store-key digest of an aval tuple + treedef (paid once per
+    distinct signature, not per dispatch)."""
+    sig = repr(list(avals)) + str(treedef)
+    return hashlib.sha1(sig.encode()).hexdigest()[:16]
+
+
+def shape_signature(args) -> str:
+    """Stable signature of a call's full argument avals: every leaf's
+    (shape, dtype) plus the treedef — stronger than the ledger's cheap
+    leading-leaf signature, because a loaded executable is called with
+    exactly these avals and a collision would raise at dispatch."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return _sig_of(_avals(leaves), treedef)
+
+
+def store_key(params_key: str, sig: str, **key_meta) -> str:
+    """The entry key: structural-params key + flavor meta (engine,
+    digest/run, num_steps, mesh, wrap — everything baked into the
+    executable besides the params) + argument-shape signature + backend
+    platform + visible device count.  The toolchain stamp is deliberately
+    NOT hashed in — see the module docstring (stale must be loud)."""
+    import jax
+
+    material = json.dumps(
+        [params_key, sorted((k, str(v)) for k, v in key_meta.items()), sig,
+         jax.default_backend(), jax.device_count()])
+    return hashlib.sha1(material.encode()).hexdigest()[:16]
+
+
+def _paths(key: str) -> tuple[str, str]:
+    d = store_dir()
+    return os.path.join(d, key + ".bin"), os.path.join(d, key + ".json")
+
+
+# ---------------------------------------------------------------------------
+# Load / save.
+# ---------------------------------------------------------------------------
+
+
+def lookup(key: str) -> tuple[str, dict | None]:
+    """Probe the store for ``key`` WITHOUT deserializing: returns
+    ``(verdict, sidecar)`` where verdict is ``"hit"`` (present, toolchain
+    matches), ``"stale"`` (present, foreign toolchain or store version),
+    or ``"miss"``."""
+    bin_path, meta_path = _paths(key)
+    if not (os.path.exists(bin_path) and os.path.exists(meta_path)):
+        return "miss", None
+    try:
+        with open(meta_path) as f:
+            side = json.load(f)
+    except (OSError, ValueError):
+        return "stale", None
+    if side.get("aot_version") != AOT_VERSION:
+        return "stale", side
+    if side.get("toolchain") != _cache.toolchain():
+        return "stale", side
+    return "hit", side
+
+
+def _deserialize(bin_path: str, side: dict | None, out_tree_thunk=None):
+    """Payload -> loaded executable.  Entries whose calling-convention
+    out-tree could not be pickled (``trees: "retrace-out"`` — e.g. the
+    checkify sanitizer's error pytree carries live traceback objects)
+    rebuild it from ``out_tree_thunk`` (an abstract ``eval_shape`` trace
+    of the live jit fn: seconds, and still no lower/backend compile)."""
+    from jax.experimental import serialize_executable as se
+
+    with open(bin_path, "rb") as f:
+        payload = pickle.load(f)
+    if side and side.get("trees") == "retrace-out":
+        if out_tree_thunk is None:
+            raise ValueError("retrace-out entry needs an out_tree_thunk")
+        serialized, in_tree = payload
+        return se.deserialize_and_load(serialized, in_tree,
+                                       out_tree_thunk())
+    return se.deserialize_and_load(*payload)
+
+
+def load(key: str, out_tree_thunk=None):
+    """Deserialize the stored executable for ``key``; returns the loaded
+    callable or ``None`` (miss / stale / corrupt — every failure is a
+    clean miss, never an exception out of this function).  The verdict and
+    true load seconds are annotated onto the compile-ledger entry being
+    attributed, if any (``aot-hit`` / ``aot-stale``)."""
+    from ..telemetry import ledger as tledger
+
+    ck = (store_dir(), key)
+    with _lock:
+        if ck in _LOADED:
+            return _LOADED[ck]
+        refused = _REFUSED.get(ck)
+    if refused is not None:
+        if refused != "aot-miss":
+            tledger.get().annotate_compile(_aot="stale")
+        return None
+    verdict, side = lookup(key)
+    if verdict == "miss":
+        with _lock:
+            _REFUSED[ck] = "aot-miss"
+        return None
+    if verdict == "stale":
+        with _lock:
+            _REFUSED[ck] = "aot-stale"
+        tledger.get().annotate_compile(_aot="stale")
+        return None
+    bin_path, _ = _paths(key)
+    t0 = time.perf_counter()
+    try:
+        loaded = _deserialize(bin_path, side, out_tree_thunk)
+    except Exception:  # corrupt bytes, device mismatch, pickle skew, ...
+        # A broken artifact must cost a fallback, never a crash: the jit
+        # path is always behind us.  Classified stale so the ledger says
+        # the store needs a rebuild rather than hiding the event.
+        with _lock:
+            _REFUSED[ck] = "aot-error"
+        tledger.get().annotate_compile(_aot="stale")
+        return None
+    load_s = time.perf_counter() - t0
+    with _lock:
+        _LOADED[ck] = loaded
+    tledger.get().annotate_compile(_aot="hit", aot_load_s=round(load_s, 6))
+    return loaded
+
+
+def save(skey: str, compiled, compile_s: float | None = None,
+         **meta) -> str | None:
+    """Serialize ``compiled`` (a jax ``Compiled``) into the store under
+    store key ``skey`` with a metadata sidecar; refreshes
+    ``manifest.json`` under an fcntl lock.  Returns the .bin path, or
+    ``None`` on any failure (export is best-effort — a read-only or full
+    disk must not break the run that compiled the executable)."""
+    from jax.experimental import serialize_executable as se
+
+    bin_path, meta_path = _paths(skey)
+    try:
+        os.makedirs(store_dir(), exist_ok=True)
+        payload = se.serialize(compiled)
+        try:
+            blob = pickle.dumps(payload)
+            trees = "full"
+        except Exception:
+            # Some calling conventions carry unpicklable aux data in the
+            # OUT tree (the checkify sanitizer's error pytree holds live
+            # tracebacks).  Store the executable + in-tree only; the
+            # loader rebuilds the out-tree from an abstract trace of the
+            # live jit fn (see _deserialize).
+            blob = pickle.dumps((payload[0], payload[1]))
+            trees = "retrace-out"
+        tmp = bin_path + ".tmp.%d" % os.getpid()
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, bin_path)
+        side = {
+            "aot_version": AOT_VERSION,
+            "store_key": skey,
+            "file": os.path.basename(bin_path),
+            "size_bytes": os.path.getsize(bin_path),
+            "toolchain": _cache.toolchain(),
+            "trees": trees,
+            "compile_s": (round(compile_s, 3)
+                          if compile_s is not None else None),
+            **meta,
+        }
+        tmp = meta_path + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(side, f, indent=1)
+        os.replace(tmp, meta_path)
+        _refresh_manifest()
+        return bin_path
+    except Exception:  # serialize refusal, pickle failure, disk trouble
+        return None
+
+
+def _refresh_manifest() -> None:
+    """Rebuild ``manifest.json`` from the sidecars, serialized across
+    concurrent writers with an fcntl lock (warm_cache children and bench
+    rungs may export into one store back-to-back)."""
+    import fcntl
+
+    d = store_dir()
+    lock_path = os.path.join(d, ".manifest.lock")
+    with open(lock_path, "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        try:
+            entries = []
+            for name in sorted(os.listdir(d)):
+                if not name.endswith(".json") or name == "manifest.json":
+                    continue
+                try:
+                    with open(os.path.join(d, name)) as f:
+                        entries.append(json.load(f))
+                except (OSError, ValueError):
+                    continue  # a concurrent writer's half-landed sidecar
+            doc = {
+                "schema": "librabft_aot_store",
+                "aot_version": AOT_VERSION,
+                "toolchain": _cache.toolchain(),
+                "entries": entries,
+            }
+            tmp = os.path.join(d, "manifest.json.tmp.%d" % os.getpid())
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, os.path.join(d, "manifest.json"))
+        finally:
+            fcntl.flock(lk, fcntl.LOCK_UN)
+
+
+def read_manifest(d: str | None = None) -> dict | None:
+    """Load ``manifest.json`` from a store dir (``None`` = the active
+    one); returns ``None`` when absent.  jax-free."""
+    path = os.path.join(d or store_dir(), "manifest.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _out_tree(jit_fn, args):
+    """The jit fn's output PyTreeDef from an abstract trace (no lowering,
+    no compile) — the loader's out-tree source for ``retrace-out``
+    entries."""
+    import jax
+
+    return jax.tree_util.tree_structure(jax.eval_shape(jit_fn, *args))
+
+
+def _reset_jax_compilation_cache() -> None:
+    """Drop jax's process-wide persistent-cache latch (private API,
+    guarded: on a jax that moved it, the export path degrades to relying
+    on the verify-by-reload step to catch hydration damage)."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
+def _export(jit_fn, args, skey: str, key: str, sig: str, key_meta: dict):
+    """Build-step miss path (``LIBRABFT_AOT_WRITE=1``): compile the chunk
+    AOT-style, export it into the store, and return the executable to
+    dispatch (``None`` on export failure — caller falls back to jit).
+
+    Two hard-won rules:
+
+    * the compile must BYPASS the persistent XLA compile cache — an
+      executable hydrated from that cache re-serializes with its object
+      code missing ("Symbols not found" at load; measured on this
+      container's jaxlib 0.4.36), so exporting demands a full fresh
+      backend compile, which is also what stamps honest compile seconds
+      into the sidecar;
+    * the written artifact is VERIFIED by deserializing it back before
+      the entry is trusted — a store that silently accumulated broken
+      entries would turn every future cold start into the fallback path
+      with an ``aot-stale`` mystery.  Misses, stale and corrupt entries
+      are all (re)written: the store must come out of a build current."""
+    import jax
+
+    from ..telemetry import ledger as tledger
+
+    prev_cache = jax.config.jax_compilation_cache_dir
+    t0 = time.perf_counter()
+    try:
+        if prev_cache:
+            # Setting the dir alone is NOT enough: jax caches its
+            # is-cache-used decision once per process, so a hydrating
+            # read (the exact failure the bypass exists to avoid) would
+            # still be served.  reset_cache() drops that latch; the
+            # second reset after restore lets later compiles re-latch
+            # onto the restored dir.
+            jax.config.update("jax_compilation_cache_dir", None)
+            _reset_jax_compilation_cache()
+        compiled = jit_fn.lower(*args).compile()
+    finally:
+        if prev_cache:
+            jax.config.update("jax_compilation_cache_dir", prev_cache)
+            _reset_jax_compilation_cache()
+    compile_s = time.perf_counter() - t0
+    leaves = jax.tree_util.tree_leaves(args)
+    arg_shapes = (f"{tuple(getattr(leaves[0], 'shape', ()))}x{len(leaves)}"
+                  if leaves else "()")
+    bin_path = save(skey, compiled, compile_s=compile_s, key=key,
+                    shapes=sig, arg_shapes=arg_shapes, **key_meta)
+    if bin_path is None:
+        # Export failed (read-only/full store dir, serialize refusal):
+        # still dispatch the fresh build, but leave the base compile
+        # verdict standing — an aot-export verdict must mean an entry
+        # actually landed (it is annotated only after save + verify).
+        return compiled
+    try:
+        _, side = lookup(skey)
+        _deserialize(bin_path, side,
+                     out_tree_thunk=lambda: _out_tree(jit_fn, args))
+    except Exception:
+        # Unloadable artifact: withdraw it (both files + manifest) so a
+        # future cold start misses cleanly instead of going stale-loud.
+        for path in _paths(skey):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        try:
+            _refresh_manifest()
+        except OSError:
+            pass
+        return compiled
+    with _lock:
+        _LOADED[(store_dir(), skey)] = compiled
+        _REFUSED.pop((store_dir(), skey), None)
+    tledger.get().annotate_compile(_aot="export")
+    return compiled
+
+
+# ---------------------------------------------------------------------------
+# The consult-before-trace wrapper.
+# ---------------------------------------------------------------------------
+
+
+def wrap_jit(jit_fn, prefix_args: tuple, key: str, **key_meta):
+    """Wrap a jitted chunk runner so its first call per argument-shape
+    signature consults the AOT store before the jit path traces.
+
+    ``jit_fn`` is the memoized ``jax.jit`` callable; ``prefix_args`` are
+    the closure-bound leading arguments the engine feeds it (delay/
+    duration tables, lookahead scalar — empty for runners taking only the
+    state); ``key`` is the structural-params key
+    (telemetry.ledger.params_key) and ``key_meta`` the flavor fields
+    (engine, digest, num_steps, mesh...) that complete the store key.
+
+    Call semantics per shape signature:
+
+    * store hit — deserialize once (module-wide cache), dispatch the
+      loaded executable; ``aot-hit`` + load seconds land on the compile-
+      ledger entry.
+    * stale / corrupt / foreign-version — ``aot-stale`` on the ledger,
+      then the untouched jit path.
+    * miss — the untouched jit path; with ``LIBRABFT_AOT_WRITE=1`` the
+      chunk is instead built AOT-style (``jit_fn.lower(args).compile()``
+      — same graph, same donation) so the fresh executable can be
+      serialized into the store, then dispatched.
+    * ``LIBRABFT_AOT=0`` — transparent pass-through, checked per call so
+      tests can toggle the knob on a live wrapper.
+
+    The returned callable forwards ``lower``/``trace``/``eval_shape``
+    and ``__wrapped__`` from ``jit_fn`` so AOT consumers (kernel census,
+    graph audit) keep driving the real staging API.
+    """
+    per_sig: dict = {}
+    sig_lock = threading.Lock()
+
+    def resolve(args, avals, treedef):
+        sig = _sig_of(avals, treedef)
+        skey = store_key(key, sig, **key_meta)
+        fn = load(skey, out_tree_thunk=lambda: _out_tree(jit_fn, args))
+        if fn is None and write_enabled():
+            fn = _export(jit_fn, args, skey, key, sig, key_meta)
+        if fn is None:
+            fn = jit_fn
+        return fn
+
+    def wrapped(*call_args):
+        args = (*prefix_args, *call_args)
+        if not enabled():
+            return jit_fn(*args)
+        import jax
+
+        # One flatten per dispatch covers both the memo key and the
+        # tracer check; the repr/sha1 store-key digest is paid only on
+        # the first call per signature (resolve).
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            # Tracer arguments mean some outer transform is TRACING
+            # through this runner (e.g. the sharded wrap="jit" A/B form
+            # jits over the engine's run fn): a loaded executable cannot
+            # consume tracers, but the jit path inlines — route there.
+            return jit_fn(*args)
+        cache_key = (_avals(leaves), treedef, store_dir())
+        with sig_lock:
+            fn = per_sig.get(cache_key)
+        if fn is None:
+            fn = resolve(args, cache_key[0], treedef)
+            with sig_lock:
+                per_sig[cache_key] = fn
+        return fn(*args)
+
+    wrapped.__wrapped__ = jit_fn
+    if not prefix_args:
+        # The staging API is forwarded only when the wrapper's calling
+        # convention matches jit_fn's (sharded/sanitize runners): with
+        # bound prefix args, run.lower(st) would silently expect the
+        # full (tables..., st) arity — better the pre-AOT AttributeError.
+        for attr in ("lower", "trace", "eval_shape"):
+            if hasattr(jit_fn, attr):
+                setattr(wrapped, attr, getattr(jit_fn, attr))
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# CLI: list the store (no jax import).
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="List an AOT executable store's manifest")
+    ap.add_argument("--list", action="store_true", help="print the manifest")
+    ap.add_argument("--dir", default=None,
+                    help=f"store directory (default ${DIR_ENV} or "
+                         f"{DEFAULT_AOT_DIR})")
+    args = ap.parse_args(argv)
+    d = args.dir or store_dir()
+    man = read_manifest(d)
+    if man is None:
+        print(f"aot: no manifest at {d} (store empty or not built — run "
+              "scripts/warm_cache.py with LIBRABFT_AOT_WRITE=1)",
+              file=sys.stderr)
+        return 1
+    tc = man.get("toolchain", {})
+    entries = man.get("entries", [])
+    total = sum(e.get("size_bytes", 0) for e in entries)
+    print(f"# aot store {d}: {len(entries)} executables, "
+          f"{total / 1e6:.1f} MB, toolchain "
+          f"jax={tc.get('jax')} jaxlib={tc.get('jaxlib')}")
+    for e in entries:
+        # arg_shapes is the operator-readable form (leading leaf shape +
+        # leaf count, like the compile ledger); `shapes` is the full aval
+        # digest the store key hashes.  Older entries only carry the hash.
+        shapes = e.get("arg_shapes") or e.get("shapes")
+        print(f"  {e.get('store_key')} {e.get('engine', '?'):>16} "
+              f"flavor={e.get('flavor', '?')} shapes={shapes} "
+              f"compile_s={e.get('compile_s')} "
+              f"{e.get('size_bytes', 0) / 1e6:.1f}MB")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

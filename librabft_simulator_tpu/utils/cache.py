@@ -17,6 +17,7 @@ warm across this consolidation.
 
 from __future__ import annotations
 
+import json
 import os
 
 CACHE_ENV = "LIBRABFT_COMPILE_CACHE"
@@ -28,6 +29,63 @@ DEFAULT_CACHE_DIR = "/tmp/jax_cache"
 #: Executables cheaper than this to compile are not worth the disk/serialize
 #: round trip (the same threshold every call site used).
 MIN_COMPILE_TIME_S = 1.0
+
+#: Name of the toolchain stamp written into the cache dir.  XLA's own
+#: cache keys incorporate the compiler version, so a jaxlib upgrade
+#: invalidates every entry *silently* — the suite just goes cold and the
+#: ledger reports bare persistent-misses (the round-11 re-baseline found
+#: this the hard way).  The stamp makes it loud: on mismatch every miss
+#: in the process is classified ``stale-toolchain`` instead.
+STAMP_FILE = "TOOLCHAIN.json"
+
+#: Set by :func:`setup_compile_cache` when the cache dir's stamp names a
+#: different toolchain than this process (telemetry/ledger.py reads it to
+#: classify the resulting misses).
+_STALE_TOOLCHAIN: dict | None = None
+
+
+def toolchain() -> dict:
+    """The toolchain stamp: the versions a compiled executable is a pure
+    function of (beyond params + shapes + backend).  Shared by the
+    persistent-cache stamp here and the AOT store (utils/aot.py)."""
+    import jax
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+
+
+def stale_toolchain() -> dict | None:
+    """The previous stamp when the persistent cache was built by another
+    toolchain (``None`` = stamp matched or no cache).  A truthy value
+    means every persistent-cache miss this process is really a
+    ``stale-toolchain`` miss — the entries exist, keyed by a compiler
+    that is gone."""
+    return _STALE_TOOLCHAIN
+
+
+def _stamp_cache_dir(d: str) -> None:
+    """Record/verify the toolchain stamp in the cache dir; flips
+    :func:`stale_toolchain` on mismatch and rewrites the stamp so the
+    NEXT session sees a warm, correctly-stamped cache."""
+    global _STALE_TOOLCHAIN
+    path = os.path.join(d, STAMP_FILE)
+    current = toolchain()
+    prior = None
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        prior = None
+    if prior is not None and prior != current:
+        _STALE_TOOLCHAIN = prior
+    if prior != current:
+        try:
+            tmp = path + ".tmp.%d" % os.getpid()
+            with open(tmp, "w") as f:
+                json.dump(current, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # read-only cache dir: stamping is best-effort
 
 
 def cache_dir() -> str | None:
@@ -56,8 +114,10 @@ def setup_compile_cache(force: bool = False) -> str | None:
         return None
     current = jax.config.jax_compilation_cache_dir
     if current and not force:
+        _stamp_cache_dir(current)
         return current
     os.makedirs(d, exist_ok=True)
+    _stamp_cache_dir(d)
     jax.config.update("jax_compilation_cache_dir", d)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       MIN_COMPILE_TIME_S)

@@ -45,6 +45,38 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
     exit 1
 fi
 
+echo "=== AOT executable store prebuild (utils/aot.py; non-fatal) ==="
+# Build/refresh the AOT store so the 870 s suite LOADS its heavy chunk
+# executables (aot-hit = deserialize seconds, no trace/lower/XLA compile)
+# instead of re-deriving them — the cold-59-vs-warm-98-dot gap is exactly
+# these compiles.  Incremental: shapes already in the store are loaded,
+# not rebuilt, so a shipped store makes this a fast verification pass.
+# Non-fatal by design: if the prebuild fails the suite falls back to
+# whatever the persistent compile cache already holds; a stale/corrupt
+# STORE ENTRY falls back to a fresh jit compile (which repopulates the
+# persistent cache for the next run — export compiles bypass it, see
+# utils/aot._export).  AOT_PREBUILD=0 skips.
+if [ "${AOT_PREBUILD:-1}" != "0" ]; then
+    if ! timeout -k 10 3000 env JAX_PLATFORMS=cpu \
+        python scripts/warm_cache.py; then
+        echo "WARN: aot prebuild failed/timed out; the suite falls back" \
+             "to the persistent compile cache" >&2
+    fi
+    # Self-warming loop: the PREVIOUS tier-1 run's streamed ledger names
+    # every chunk executable the suite actually compiled — export exactly
+    # those (first adoption pays the compiles once; afterwards the
+    # children just load-verify and exit).
+    if [ -f /tmp/_t1_ledger.ndjson ]; then
+        if ! timeout -k 10 3000 env JAX_PLATFORMS=cpu \
+            python scripts/warm_cache.py \
+            --from-ledger /tmp/_t1_ledger.ndjson; then
+            echo "WARN: ledger-driven aot warm failed/timed out" \
+                 "(non-fatal)" >&2
+        fi
+    fi
+    python -m librabft_simulator_tpu.utils.aot --list || true
+fi
+
 echo "=== tier-1 test suite ==="
 set -o pipefail
 rm -f /tmp/_t1.log /tmp/_t1_ledger.ndjson
@@ -74,11 +106,14 @@ with open("/tmp/_t1_compile_attribution.json") as f:
     a = json.load(f)
 cvr = a["compile_vs_run"]
 pc = a["compile"]["persistent_cache"]
+aot = a["compile"].get("aot", {})
 print(f"tier-1 attribution: compile {cvr['compile_s']}s vs run "
       f"{cvr['run_s']}s (compile fraction {cvr['compile_fraction']}); "
       f"{a['compile']['entries']} builds over "
       f"{a['compile']['distinct_keys']} structural keys, persistent cache "
-      f"{pc['hits']} hits / {pc['misses']} misses "
+      f"{pc['hits']} hits / {pc['misses']} misses, aot store "
+      f"{aot.get('hits', 0)} hits / {aot.get('stale', 0)} stale "
+      f"({aot.get('load_s', 0)}s load) "
       f"-> /tmp/_t1_compile_attribution.json")
 EOF
 else
@@ -97,6 +132,11 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_multichip.py tests/test_stream.py tests/test_audit.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 parity_rc=$?
+
+echo "=== AOT store referees (tests/test_aot.py in FULL — the store-backed round trips are slow-marked out of the 870 s suite because their export fixture deliberately pays ~4 fresh compiles) ==="
+timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_aot.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+aot_rc=$?
 
 echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
@@ -124,6 +164,10 @@ if [ "$tests_ok" -ne 0 ]; then
 fi
 if [ "$parity_rc" -ne 0 ]; then
     echo "FAIL: fleet parity / stream / audit referees rc=$parity_rc" >&2
+    exit 1
+fi
+if [ "$aot_rc" -ne 0 ]; then
+    echo "FAIL: AOT store referees rc=$aot_rc" >&2
     exit 1
 fi
 if [ "$census_rc" -ne 0 ]; then

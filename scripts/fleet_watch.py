@@ -169,11 +169,24 @@ def show_ledger(path: str, out=None) -> int:
     compiles = [r for r in rows if r.get("kind") == "compile"]
     if compiles:
         printed = True
-        print(f"# compile ledger: {len(compiles)} builds", file=out)
+        aot_hits = sum(1 for e in compiles if e.get("cache") == "aot-hit")
+        aot_stale = sum(1 for e in compiles if e.get("cache") == "aot-stale")
+        print(f"# compile ledger: {len(compiles)} builds"
+              + (f" ({aot_hits} aot-hit)" if aot_hits else "")
+              + (f" ({aot_stale} AOT-STALE — rebuild the store: "
+                 f"scripts/warm_cache.py)" if aot_stale else ""), file=out)
         for e in compiles:
+            # aot-hit entries paid deserialize seconds, not a compile;
+            # aot-stale entries name the fallback verdict they fell to.
+            if e.get("cache") == "aot-hit":
+                cost = f"aot_load_s={e.get('aot_load_s', 0):.2f}"
+            else:
+                cost = f"compile_s={e.get('compile_s', 0):.2f}"
+            verdict = e.get("cache")
+            if e.get("fallback"):
+                verdict = f"{verdict}->{e['fallback']}"
             print(f"  {e.get('key')} {e.get('engine', '?'):>14} "
-                  f"shapes={e.get('shapes')} {e.get('cache')} "
-                  f"compile_s={e.get('compile_s', 0):.2f} "
+                  f"shapes={e.get('shapes')} {verdict} {cost} "
                   f"first_call_s={e.get('first_call_s', 0):.2f}", file=out)
     if not printed:
         print("no ledger rows yet", file=sys.stderr)

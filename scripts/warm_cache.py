@@ -1,15 +1,30 @@
-"""Pre-warm the XLA persistent compile cache for the test suite.
+"""Build the AOT executable store (and warm the compile caches) for the
+test suite: THE build step of the compile-tax pipeline.
 
-The suite (tests/conftest.py) runs the cache READ-ONLY: cache writes
-(``executable.serialize()``) segfault jaxlib in long-running processes that
-have accumulated many large compiles.  In a fresh process per shape the
-writes are reliable — so this script compiles each heavy (engine, shape)
-pair in its own subprocess, after which the suite runs from cache.
+Each heavy (engine, shape) pair compiles in its own subprocess (a single
+long-lived process accumulating many large compiles risks the jaxlib
+serialize segfault), and — by default — each child runs with
+``LIBRABFT_AOT_WRITE=1``: every chunk executable it builds is exported
+into the AOT store (utils/aot.py, ``LIBRABFT_AOT_DIR``) as a serialized
+ready-to-load artifact with a manifest entry.  CI and fleet start then
+LOAD those executables (an ``aot-hit`` pays deserialize seconds, not
+trace+lower+XLA-compile), which is what turns the 42 s cold fleet start
+into seconds and the tier-1 cold-dot gap into the warm count.
+
+The export compile deliberately bypasses the persistent XLA compile
+cache (a cache-hydrated executable re-serializes broken — see
+utils/aot._export), so with AOT on this script warms the AOT STORE; run
+it with ``LIBRABFT_AOT=0`` to get the old persistent-cache-only warming
+behavior.
 
 Usage:  python scripts/warm_cache.py            # suite shapes (incl. sharded)
         python scripts/warm_cache.py --bench    # bench + 5-config sweep shapes
         python scripts/warm_cache.py --fleet    # BENCH_FLEET dp-ladder rungs
         python scripts/warm_cache.py --macro    # BENCH_MACRO K-ladder rungs
+        python scripts/warm_cache.py --from-ledger PATH  # every chunk
+                                                # executable a previous run's
+                                                # streamed runtime ledger
+                                                # records (data-driven matrix)
         python scripts/warm_cache.py --list     # show shapes
 
 ``--bench`` drives bench.py itself (one child per config, BENCH_REPS=1) so
@@ -50,6 +65,8 @@ SHAPES = [
 # The tier-1 micro fleet shapes, shared with tests/test_multichip.py via
 # the pure-data module tests/fleet_shapes.py so the warmed executables and
 # the suite's compiled shapes can never drift apart.
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))  # package root (aot manifest read)
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", "tests"))
 from fleet_shapes import (  # noqa: E402
@@ -105,7 +122,12 @@ SHARDED_SHAPES = [
     ("serial", FLEET_MACRO_WD_SER_KW, FLEET_B, FLEET_CHUNK, 2),
 ]
 
-CHILD = r"""
+#: Shared child preamble: pin the CPU backend BEFORE the jax import and
+#: force the tier-1 suite's device count (tests/conftest.py).  The
+#: device count is load-bearing for the AOT store — store keys hash
+#: jax.device_count(), so an export under any other count could never be
+#: loaded by the suite (a permanent silent aot-miss).
+CHILD_PREAMBLE = r"""
 import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
@@ -114,6 +136,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
+"""
+
+CHILD = CHILD_PREAMBLE + r"""
 import sys, json
 import numpy as np
 sys.path.insert(0, %(root)r)
@@ -152,11 +177,7 @@ for e in tledger.get().compiles:
 """
 
 
-SANITIZE_CHILD = r"""
-import os
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-import jax
-jax.config.update("jax_platforms", "cpu")
+SANITIZE_CHILD = CHILD_PREAMBLE + r"""
 import sys, json
 import numpy as np
 sys.path.insert(0, %(root)r)
@@ -176,15 +197,7 @@ print("warmed sanitize", engine_name, kw, batch)
 """
 
 
-SHARDED_CHILD = r"""
-import os
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-import jax
-jax.config.update("jax_platforms", "cpu")
+SHARDED_CHILD = CHILD_PREAMBLE + r"""
 import sys, json
 sys.path.insert(0, %(root)r)
 from librabft_simulator_tpu.telemetry import ledger as tledger
@@ -209,38 +222,172 @@ for e in tledger.get().compiles:
 """
 
 
+LEDGER_CHILD = CHILD_PREAMBLE + r"""
+import sys, json, ast
+import numpy as np
+sys.path.insert(0, %(root)r)
+from librabft_simulator_tpu.telemetry import ledger as tledger
+from librabft_simulator_tpu.utils.cache import setup_compile_cache
+setup_compile_cache()
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.sim import parallel_sim, simulator
+from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+
+engine_name, structural, b, num_steps, batched, digest = json.loads(sys.argv[1])
+engine = parallel_sim if engine_name == "lane" else simulator
+# The ledger row's `structural` field IS a SimParams repr (the compile
+# ledger records it per entry) — rebuild the exact params the suite
+# compiled.  max_clock is normalized to 0 there (runtime data, outside
+# the jit key), so one immediately-halting chunk call is enough to
+# build-or-load the executable.  Parsed with ast, NOT eval: the ledger
+# file lives at a predictable /tmp path, and a dataclass repr that stops
+# being literal kwargs should fail loudly here, not execute.
+call = ast.parse(structural, mode="eval").body
+if not (isinstance(call, ast.Call) and isinstance(call.func, ast.Name)
+        and call.func.id == "SimParams" and not call.args):
+    raise ValueError("structural field is not a SimParams(...) repr: "
+                     + structural[:120])
+p = SimParams(**{k.arg: ast.literal_eval(k.value) for k in call.keywords})
+if batched:
+    st = dedupe_buffers(engine.init_batch(p, np.arange(b, dtype=np.uint32)))
+else:
+    st = dedupe_buffers(engine.init_state(p, 0))
+run = engine.make_run_fn(p, num_steps, batched=batched, digest=digest)
+out = run(st)
+jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+for e in tledger.get().compiles:
+    print("  compile", e["key"], e["shapes"], e["cache"],
+          "compile_s=%%.1f" %% e["compile_s"])
+"""
+
+
+def warm_from_ledger(root: str, path: str) -> None:
+    """Warm/export EXACTLY the chunk executables a previous run compiled,
+    read from its streamed runtime ledger (``LIBRABFT_LEDGER_OUT`` NDJSON
+    — e.g. /tmp/_t1_ledger.ndjson from the last tier-1 run).
+
+    This makes the warm matrix DATA-DRIVEN: the static SHAPES above cover
+    the known referee contracts, but the suite compiles many more
+    (engine, structural, num_steps, batch) combinations than anyone
+    should hand-maintain — the ledger already records every one of them,
+    with the full structural-params repr.  One child per distinct key
+    (the fresh-process export protocol); entries already in the AOT store
+    just load and exit, so repeat runs are cheap.  Sharded rows are
+    skipped (their mesh/wrap context lives in SHARDED_SHAPES)."""
+    import re
+
+    from librabft_simulator_tpu.telemetry.ledger import read_ndjson
+
+    try:
+        rows = read_ndjson(path)
+    except (OSError, ValueError) as e:
+        print(f"[warm_cache] --from-ledger: cannot read {path}: {e}",
+              file=sys.stderr)
+        return
+    seen = {}
+    for r in rows:
+        if r.get("kind") != "compile":
+            continue
+        if r.get("engine") not in ("serial", "lane"):
+            continue  # sharded/sanitize flavors ride their static lists
+        if not r.get("structural") or r.get("num_steps") is None:
+            continue
+        b = None
+        if r.get("batched"):
+            m = re.match(r"\((\d+)", str(r.get("shapes", "")))
+            if not m:
+                continue
+            b = int(m.group(1))
+        key = (r["engine"], r["structural"], b, int(r["num_steps"]),
+               bool(r.get("batched")), bool(r.get("digest")))
+        seen.setdefault(key, r)
+    print(f"[warm_cache] --from-ledger {path}: {len(seen)} distinct "
+          f"chunk executables", flush=True)
+    import json
+
+    env = _build_env()
+    for key in seen:
+        engine_name, structural, b, num_steps, batched, digest = key
+        r = subprocess.run(
+            [sys.executable, "-c", LEDGER_CHILD % {"root": root},
+             json.dumps(list(key))],
+            cwd=root, env=env)
+        print(f"[warm_cache] ledger shape {engine_name} b={b} "
+              f"steps={num_steps} digest={digest}: rc={r.returncode}",
+              flush=True)
+    _print_store_summary()
+
+
+def _build_env(**extra) -> dict:
+    """Child environment: the AOT build knob rides along — children
+    export their chunk executables into the store unless the caller
+    disabled the store (``LIBRABFT_AOT=0``) or pinned the write knob
+    themselves."""
+    from librabft_simulator_tpu.utils import aot
+
+    env = dict(os.environ, **extra)
+    if aot.enabled():
+        env.setdefault("LIBRABFT_AOT_WRITE", "1")
+    return env
+
+
+def _print_store_summary() -> None:
+    """One line on what the build produced (jax-free manifest read)."""
+    from librabft_simulator_tpu.utils import aot
+
+    man = aot.read_manifest()
+    if man is None:
+        print("[warm_cache] aot store: none (exports disabled or failed)",
+              flush=True)
+        return
+    entries = man.get("entries", [])
+    total = sum(e.get("size_bytes", 0) for e in entries)
+    print(f"[warm_cache] aot store {aot.store_dir()}: {len(entries)} "
+          f"executables, {total / 1e6:.1f} MB "
+          f"(python -m librabft_simulator_tpu.utils.aot --list)", flush=True)
+
+
 def warm_fleet(root: str) -> None:
-    """Compile every BENCH_FLEET ladder rung into bench.py's persistent
-    cache (one subprocess per rung is the ladder's own protocol, so shapes
-    — dp, per-shard batch, chunk — match the real run exactly and
-    ``BENCH_FLEET=1 python bench.py`` afterwards pays ~0 s compile)."""
-    env = dict(os.environ, BENCH_FLEET="1", BENCH_FLEET_REPS="1",
-               BENCH_FLEET_OUT="/tmp/warm_fleet.json")
+    """Compile every BENCH_FLEET ladder rung into the AOT store +
+    bench.py's persistent cache (one subprocess per rung is the ladder's
+    own protocol, so shapes — dp, per-shard batch, chunk — match the real
+    run exactly and ``BENCH_FLEET=1 python bench.py`` afterwards pays
+    deserialize seconds, not compile)."""
+    # BENCH_FLEET_AOT_AB=0: warming wants the production-path executables
+    # only — the A/B's LIBRABFT_AOT=0 leg deliberately re-measures the
+    # compile this build exists to pre-pay.
+    env = _build_env(BENCH_FLEET="1", BENCH_FLEET_REPS="1",
+                     BENCH_FLEET_AOT_AB="0",
+                     BENCH_FLEET_OUT="/tmp/warm_fleet.json")
     r = subprocess.run([sys.executable, "bench.py"], cwd=root, env=env,
                        stdout=subprocess.DEVNULL)
     print(f"[warm_cache] fleet ladder: rc={r.returncode}", flush=True)
+    _print_store_summary()
 
 
 def warm_macro(root: str) -> None:
-    """Compile every BENCH_MACRO K-ladder rung into bench.py's persistent
-    cache (one subprocess per rung is the ladder's own protocol; the
-    census compile is skipped — only the timed chunk executables warm,
-    which is what a real BENCH_MACRO=1 run re-censuses anyway)."""
-    env = dict(os.environ, BENCH_MACRO="1", BENCH_REPS="1",
-               BENCH_MACRO_CENSUS="0",
-               BENCH_MACRO_OUT="/tmp/warm_macro.json")
+    """Compile every BENCH_MACRO K-ladder rung into the AOT store +
+    bench.py's persistent cache (one subprocess per rung is the ladder's
+    own protocol; the census compile is skipped — only the timed chunk
+    executables warm, which is what a real BENCH_MACRO=1 run re-censuses
+    anyway)."""
+    env = _build_env(BENCH_MACRO="1", BENCH_REPS="1",
+                     BENCH_MACRO_CENSUS="0",
+                     BENCH_MACRO_OUT="/tmp/warm_macro.json")
     r = subprocess.run([sys.executable, "bench.py"], cwd=root, env=env,
                        stdout=subprocess.DEVNULL)
     print(f"[warm_cache] macro ladder: rc={r.returncode}", flush=True)
+    _print_store_summary()
 
 
 def warm_bench(root: str) -> None:
-    """Compile every bench/sweep shape into bench.py's persistent cache.
+    """Compile every bench/sweep shape into the AOT store + bench.py's
+    persistent cache.
 
     One child per config (a single long-lived process accumulating many big
     compiles risks the serialize-segfault the module docstring describes).
     """
-    env = dict(os.environ, BENCH_PLATFORM="cpu", BENCH_REPS="1")
+    env = _build_env(BENCH_PLATFORM="cpu", BENCH_REPS="1")
     # The headline bench shape (both engines), then every sweep config.
     # Count derived from bench.sweep_configs in a CHILD (importing bench
     # here would run its module-level backend attach in this process).
@@ -258,6 +405,7 @@ def warm_bench(root: str) -> None:
         r = subprocess.run([sys.executable, "bench.py"], cwd=root, env=env_i,
                            stdout=subprocess.DEVNULL)
         print(f"[warm_cache] sweep config {i}: rc={r.returncode}", flush=True)
+    _print_store_summary()
 
 
 def main():
@@ -279,29 +427,35 @@ def main():
     if "--macro" in sys.argv:
         warm_macro(root)
         return
+    if "--from-ledger" in sys.argv:
+        warm_from_ledger(
+            root, sys.argv[sys.argv.index("--from-ledger") + 1])
+        return
     import json
 
+    env = _build_env()
     for e, kw, b, c in SHAPES:
         r = subprocess.run(
             [sys.executable, "-c", CHILD % {"root": root},
              json.dumps([e, kw, b, c])],
-            cwd=root)
+            cwd=root, env=env)
         print(f"[warm_cache] {e} {kw} b={b} chunk={c}: rc={r.returncode}",
               flush=True)
     for e, kw, b, c, dp in SHARDED_SHAPES:
         r = subprocess.run(
             [sys.executable, "-c", SHARDED_CHILD % {"root": root},
              json.dumps([e, kw, b, c, dp])],
-            cwd=root)
+            cwd=root, env=env)
         print(f"[warm_cache] sharded {e} {kw} b={b} chunk={c} dp={dp}: "
               f"rc={r.returncode}", flush=True)
     for e, kw, b, c in SANITIZE_SHAPES:
         r = subprocess.run(
             [sys.executable, "-c", SANITIZE_CHILD % {"root": root},
              json.dumps([e, kw, b, c])],
-            cwd=root)
+            cwd=root, env=env)
         print(f"[warm_cache] sanitize {e} {kw} b={b} chunk={c}: "
               f"rc={r.returncode}", flush=True)
+    _print_store_summary()
 
 
 if __name__ == "__main__":
