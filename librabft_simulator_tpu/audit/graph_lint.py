@@ -127,9 +127,14 @@ R1_EXPECTED_WAIVED = {
     # the jaxpr carries the same single waived site regardless of K.
     "serial/tpu_shape_k4": 1,
     "serial/tpu_shape_k16": 1,
+    # Scenario-plane flavor (SimParams.scenario): per-slot knobs ride as
+    # traced data; no new write sites — the plane is READ-only config
+    # (the R6 scenario arm pins pass-through).
+    "serial/tpu_shape_scenario": 1,
     "lane/tpu_shape": 13,         # lane scatter-back + inbox routing
     "lane/tpu_telemetry": 14,     # + the flight-recorder ring scatter
     "lane/tpu_watchdog": 13,
+    "lane/tpu_shape_scenario": 13,
 }
 
 
@@ -599,6 +604,12 @@ def _flavors(base_kw: dict, engine_name: str = "serial"):
         ("tpu_watchdog", dict(TPU_FORMS, watchdog=True),
          ("R1", "R2", "R3", "R4")),
     ]
+    # Scenario-plane flavor (SimParams.scenario; serve/): per-slot traced
+    # delay table + commit-chain select.  Same write/dtype/callback/carry
+    # rules on the scenario graph; the R6 scenario arm adds the
+    # off-inert / read-only pass-through pins.
+    flavors.append(("tpu_shape_scenario", dict(TPU_FORMS, scenario=True),
+                    ("R1", "R2", "R3", "R4")))
     if engine_name == "serial":
         flavors += [
             ("tpu_shape_k4", dict(TPU_FORMS, macro_k=4),
@@ -636,6 +647,68 @@ def check_r6_macro(engine_name: str, base_kw: dict,
             "graph differs from the bare step — the default no longer "
             "lowers to the exact pre-macro graph", "")]
     return []
+
+
+def check_r6_scenario(engine_name: str, base_kw: dict,
+                      traces: dict | None = None) -> list[Finding]:
+    """The scenario plane's R6 arm — two static pins:
+
+    * **off-inert**: with ``scenario=False`` the sc_* state leaves are
+      zero-width and NO eqn consumes them — the step graph is the exact
+      static-knob lowering (the census twin: existing budgets unchanged);
+    * **read-only pass-through**: with ``scenario=True`` the step must
+      return ``sc_delay``/``sc_commit`` as the IDENTITY of its inputs
+      (the same jaxpr Var) — the plane is per-slot config, and an engine
+      write to it would let one chunk silently rewrite a slot's scenario
+      out from under the resident service's admission bookkeeping."""
+    traces = dict(traces or {})
+    findings = []
+
+    def get(name, **kw):
+        if name not in traces:
+            p = SimParams(**base_kw, **TPU_FORMS, **kw)
+            cj, paths, _ = trace_step(engine_name, p)
+            traces[name] = (cj, paths)
+        return traces[name]
+
+    def sc_slots(cj, paths):
+        invars = cj.jaxpr.invars
+        offset = len(invars) - len(paths)
+        idx = [i for i, pth in enumerate(paths)
+               if ".sc_delay" in pth or ".sc_commit" in pth]
+        return offset, idx
+
+    cj_off, paths_off = get("tpu_shape")
+    offset, idx = sc_slots(cj_off, paths_off)
+    if len(idx) != 2:
+        findings.append(Finding(
+            "R6", f"{engine_name}/tpu_shape", "error",
+            f"expected the 2 zero-width scenario leaves in the off state "
+            f"(sc_delay, sc_commit), found {len(idx)} — the state layout "
+            "drifted from the audited contract", ""))
+        return findings
+    off_vars = {cj_off.jaxpr.invars[offset + i] for i in idx}
+    for eqn in cj_off.jaxpr.eqns:
+        used = [v for v in eqn.invars
+                if not isinstance(v, Literal) and v in off_vars]
+        if used:
+            findings.append(Finding(
+                "R6", f"{engine_name}/tpu_shape", "error",
+                f"scenario-OFF graph consumes a zero-width sc leaf in "
+                f"{eqn.primitive.name} — the off graph must be the exact "
+                "static lowering (census budgets depend on it)",
+                eqn_site(eqn)))
+    cj_on, paths_on = get("tpu_shape_scenario", scenario=True)
+    offset_on, idx_on = sc_slots(cj_on, paths_on)
+    for i in idx_on:
+        if cj_on.jaxpr.outvars[i] is not cj_on.jaxpr.invars[offset_on + i]:
+            findings.append(Finding(
+                "R6", f"{engine_name}/tpu_shape_scenario", "error",
+                f"scenario plane leaf {paths_on[i]} is not passed through "
+                "unchanged — the plane is read-only per-slot config; an "
+                "engine write to it would rewrite a slot's scenario out "
+                "from under the admission bookkeeping", ""))
+    return findings
 
 
 def audit_engine(engine_name: str, base_kw: dict, r6: bool = True,
@@ -679,6 +752,7 @@ def audit_engine(engine_name: str, base_kw: dict, r6: bool = True,
     if r6:
         findings += check_r6_engine(engine_name, base_kw, engine_name,
                                     traces=traces)
+        findings += check_r6_scenario(engine_name, base_kw, traces=traces)
         if engine_name == "serial":
             findings += check_r6_macro(engine_name, base_kw, traces=traces)
     return findings, stats

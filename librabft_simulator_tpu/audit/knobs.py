@@ -80,6 +80,18 @@ KNOBS: tuple[Knob, ...] = (
          "relocatable artifact dir of serialized executables + sidecars "
          "+ manifest.json, built by scripts/warm_cache.py and listed by "
          "python -m librabft_simulator_tpu.utils.aot --list."),
+    Knob("LIBRABFT_SERVE_SLOTS", "engine", "serve/api.py", "int >= 1",
+         "Resident fleet service: slot count of the continuously-batched "
+         "fleet (default 8; rounded up to the mesh size).  FleetService "
+         "constructor args override."),
+    Knob("LIBRABFT_SERVE_CHUNK", "engine", "serve/api.py", "int >= 1",
+         "Resident fleet service: macro-steps per dispatched chunk "
+         "(default 64) — the admission/egress granularity, since the "
+         "host inspects one [13] digest per chunk."),
+    Knob("LIBRABFT_SERVE_OUT", "engine", "serve/api.py", "path",
+         "Stream the service's digest + request-lifecycle NDJSON here "
+         "(admission queue depth, slot occupancy, per-request ttfc); "
+         "follow live with scripts/fleet_watch.py --serve."),
     Knob("LIBRABFT_AOT_WRITE", "engine", "utils/aot.py", "0|1",
          "Export freshly compiled chunk executables back into the AOT "
          "store on a miss (default off; warm_cache children set it). "
@@ -175,6 +187,12 @@ KNOBS: tuple[Knob, ...] = (
          "Randomize the serial engine's macro_k per trial (K in "
          "{1,2,4,8}; minidumps record it); writes the macro-flavor "
          "campaign artifact FUZZ_PARITY_r11_macro.json."),
+    Knob("FUZZ_SCENARIO", "fuzz", "scripts/fuzz_parity.py", "0|1",
+         "Heterogeneous-fleet mode: every trial runs a small batch of "
+         "randomized per-slot scenario rows (delay/drop/commit-chain/"
+         "Byzantine schedule/seed) on ONE scenario-armed executable and "
+         "pins each slot against its own oracle; minidumps record the "
+         "full plane.  Writes FUZZ_PARITY_r14_scenario.json."),
     # --- script-local ---------------------------------------------------
     Knob("LADDER_UNROLL", "script", "scripts/tpu_ladder.py", "0|1",
          "Census/ladder the unrolled-scan variant."),

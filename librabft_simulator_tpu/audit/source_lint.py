@@ -169,7 +169,9 @@ SANCTIONED_SYNCS = {
 
 #: Functions that read os.environ with a key passed by parameter; the lint
 #: checks their CALL SITES' first argument instead of the read inside.
-READER_HELPERS = {"_bool_env"}
+#: _bool_knob is utils/aot.py's jax-free restatement of _bool_env;
+#: _int_env is serve/api.py's integer twin.
+READER_HELPERS = {"_bool_env", "_bool_knob", "_int_env"}
 
 
 # ---------------------------------------------------------------------------

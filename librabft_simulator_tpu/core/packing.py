@@ -162,6 +162,8 @@ class PackedSimState:
     metrics: Array
     flight: Array
     wd: Array
+    sc_delay: Array
+    sc_commit: Array
 
 
 _SIM_COMMON = _common_fields(SimState)

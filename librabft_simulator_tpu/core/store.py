@@ -14,7 +14,10 @@ Key mappings:
   try_insert_network_record -> insert_block / insert_vote / insert_qc / insert_timeout
   update_current_round    -> update_current_round (record_store.rs:207-219)
   update_commit_3chain_round -> update_commit_chain (record_store.rs:221-235),
-      generalized to ``params.commit_chain`` (3 = LibraBFTv2, 2 = HotStuff-style)
+      generalized to ``params.commit_chain`` (3 = LibraBFTv2, 2 = HotStuff-style;
+      a static int normally, or a TRACED per-slot scalar when the scenario
+      plane is on — types.TracedParams — in which case the commit-rule sites
+      compute both depths and select, bit-identically per slot)
   vote_committed_state    -> vote_committed_state (record_store.rs:237-255)
   compute_state           -> compute_state (record_store.rs:426-454)
   check_for_new_quorum_certificate -> check_new_qc (record_store.rs:702-738)
@@ -163,21 +166,45 @@ def vote_committed_state(p: SimParams, s: Store, blk_round, blk_var):
     ``undeterminable`` is True when the store is *anchored* (state-sync jump,
     data_sync.py) and the walk touched the synthetic anchor QC, whose history
     is unknown — the receiver must then trust the (signature-backed) commit
-    fields of the incoming record rather than recompute them."""
+    fields of the incoming record rather than recompute them.
+
+    ``p.commit_chain`` may be a TRACED per-slot scalar (types.TracedParams,
+    scenario plane): the walk then runs to the max depth (2 hops) once and
+    the C=2/C=3 predicates are selected by the traced value — per-slot
+    values are bit-identical to the static graph of that depth."""
     C = p.commit_chain
     r_top = _i32(blk_round)
     found0, pr, pv = prev_qc_of_block(p, s, blk_round, blk_var)
+    if isinstance(C, int):
+        valids, rounds, vars_, hits = qc_walk_back(
+            p, s, found0 & (pv >= 0), pr, jnp.maximum(pv, 0), C - 1
+        )
+        ok = jnp.bool_(True)
+        prev_r = r_top
+        for i in range(C - 1):
+            ok = ok & valids[i] & (prev_r == rounds[i] + 1)
+            prev_r = rounds[i]
+        touched = (found0 & (pv < 0)) | jnp.any(hits[: C - 1])
+        undet = s.anchored & touched
+        d, t = _qc_state(p, s, rounds[C - 2], vars_[C - 2])
+        zero_d = _i32(0)
+        zero_t = jnp.zeros((), U32)
+        return ok, jnp.where(ok, d, zero_d), jnp.where(ok, t, zero_t), undet
+    # Traced commit_chain in {2, 3}.
     valids, rounds, vars_, hits = qc_walk_back(
-        p, s, found0 & (pv >= 0), pr, jnp.maximum(pv, 0), C - 1
+        p, s, found0 & (pv >= 0), pr, jnp.maximum(pv, 0), 2
     )
-    ok = jnp.bool_(True)
-    prev_r = r_top
-    for i in range(C - 1):
-        ok = ok & valids[i] & (prev_r == rounds[i] + 1)
-        prev_r = rounds[i]
-    touched = (found0 & (pv < 0)) | jnp.any(hits[: C - 1])
+    is3 = jnp.asarray(C, I32) >= 3
+    ok2 = valids[0] & (r_top == rounds[0] + 1)
+    ok3 = ok2 & valids[1] & (rounds[0] == rounds[1] + 1)
+    ok = jnp.where(is3, ok3, ok2)
+    touched2 = (found0 & (pv < 0)) | hits[0]
+    touched = jnp.where(is3, touched2 | hits[1], touched2)
     undet = s.anchored & touched
-    d, t = _qc_state(p, s, rounds[C - 2], vars_[C - 2])
+    d2, t2 = _qc_state(p, s, rounds[0], vars_[0])
+    d3, t3 = _qc_state(p, s, rounds[1], vars_[1])
+    d = jnp.where(is3, d3, d2)
+    t = jnp.where(is3, t3, t2)
     zero_d = _i32(0)
     zero_t = jnp.zeros((), U32)
     return ok, jnp.where(ok, d, zero_d), jnp.where(ok, t, zero_t), undet
@@ -203,15 +230,26 @@ def compute_state(p: SimParams, s: Store, blk_round, blk_var):
 
 def update_commit_chain(p: SimParams, s: Store, qc_round, qc_var) -> Store:
     """The 3-chain (or C-chain) commit rule applied after inserting the QC at
-    (qc_round, qc_var) (record_store.rs:221-235)."""
+    (qc_round, qc_var) (record_store.rs:221-235).  ``p.commit_chain`` may be
+    a traced per-slot scalar (scenario plane): both depths are computed from
+    one max-depth walk and the traced value selects, bit-identically per
+    slot (see vote_committed_state)."""
     C = p.commit_chain
-    valids, rounds, _, _ = qc_walk_back(p, s, True, qc_round, qc_var, C)
-    ok = jnp.bool_(True)
-    for i in range(C):
-        ok = ok & valids[i]
-        if i > 0:
-            ok = ok & (rounds[i - 1] == rounds[i] + 1)
-    r1 = rounds[C - 1]
+    if isinstance(C, int):
+        valids, rounds, _, _ = qc_walk_back(p, s, True, qc_round, qc_var, C)
+        ok = jnp.bool_(True)
+        for i in range(C):
+            ok = ok & valids[i]
+            if i > 0:
+                ok = ok & (rounds[i - 1] == rounds[i] + 1)
+        r1 = rounds[C - 1]
+    else:
+        valids, rounds, _, _ = qc_walk_back(p, s, True, qc_round, qc_var, 3)
+        is3 = jnp.asarray(C, I32) >= 3
+        ok2 = valids[0] & valids[1] & (rounds[0] == rounds[1] + 1)
+        ok3 = ok2 & valids[2] & (rounds[1] == rounds[2] + 1)
+        ok = jnp.where(is3, ok3, ok2)
+        r1 = jnp.where(is3, rounds[2], rounds[1])
     ok = ok & (r1 > s.hcr)
     return s.replace(
         hcr=jnp.where(ok, r1, s.hcr),
@@ -618,6 +656,8 @@ def committed_states_after(p: SimParams, s: Store, after_round):
     W = p.window
     start_r = jnp.where(s.hcc_valid, s.hcc_round, _i32(0))
     valids, rounds, vars_, _ = qc_walk_back(p, s, s.hcc_valid, start_r, s.hcc_var, W)
+    # Works for both a static int and a traced per-slot commit_chain
+    # (scenario plane): the skip count only feeds the elementwise keep mask.
     skip = p.commit_chain - 1
     idx = jnp.arange(W)
     keep = valids & (idx >= skip) & (rounds > _i32(after_round))

@@ -62,6 +62,15 @@ ELECTION_ONGOING = 0
 ELECTION_WON = 1
 ELECTION_CLOSED = 2
 
+#: The delay-family field defaults that compile keys normalize out — the
+#: single source for BOTH ``SimParams.structural()`` and the sharded
+#: runner's scenario-armed cache/AOT key (parallel/sharded.py).  Two
+#: copies of these literals would let the keys drift apart, silently
+#: reintroducing the per-config recompiles the scenario plane eliminates.
+DELAY_KEY_DEFAULTS = dict(delay_kind="lognormal", delay_mean=10.0,
+                          delay_variance=4.0, delay_pareto_scale=5.0,
+                          delay_pareto_alpha=1.5)
+
 
 @dataclasses.dataclass(frozen=True)
 class SimParams:
@@ -206,6 +215,22 @@ class SimParams:
     # (tests/test_stream.py + the kernel-census CI gate).
     watchdog: bool = False
     watchdog_stall_events: int = 512  # static liveness-stall threshold
+    # Per-slot traced scenario plane (serve/scenario.py): when ON, the
+    # per-instance scenario knobs that used to be compile-time params ride
+    # in SimState as traced data — the delay quantile table becomes a
+    # per-slot [T] int32 row (``sc_delay``) and the commit rule becomes a
+    # traced 2-vs-3-chain select on ``sc_commit`` (core/store.py reads it
+    # through :class:`TracedParams`); drop rate, horizon, rng seed, and
+    # the Byzantine masks were already per-instance state.  ONE compiled
+    # executable then serves a heterogeneous fleet of scenarios
+    # (``structural()`` additionally normalizes ``commit_chain``, and the
+    # sharded runner stops baking delay tables into its key), which is
+    # what the resident fleet service (serve/) runs on.  Static and
+    # default OFF: disabled, the sc_* leaves are zero-width and the step
+    # compiles to the exact static-knob graph (tests/test_serve.py + the
+    # kernel-census gates); per-slot values are bit-identical to a
+    # dedicated static run of the same scenario.
+    scenario: bool = False
 
     def __post_init__(self):
         if self.epoch_handoff and self.handoff_epochs < 1:
@@ -228,6 +253,13 @@ class SimParams:
                 f"watchdog_stall_events must be >= 1 when the watchdog is "
                 f"on (got {self.watchdog_stall_events}); a zero threshold "
                 "would trip the liveness-stall detector on every event")
+        if self.scenario and self.commit_chain not in (2, 3):
+            raise ValueError(
+                f"commit_chain must be 2 (HotStuff-style) or 3 "
+                f"(LibraBFTv2) when the scenario plane is on, got "
+                f"{self.commit_chain}; the traced per-slot select in "
+                "core/store.py covers exactly these depths (static runs "
+                "keep the generic Python-unrolled C-chain walk)")
 
     @property
     def lam_fp(self) -> int:
@@ -243,11 +275,19 @@ class SimParams:
         defaults.  Two SimParams with equal ``structural()`` share one
         compiled step executable — the tables ride in as runtime arguments
         and max_clock/drop_u32 live in SimState — which is what keeps the
-        test suite's XLA compile count down."""
-        return dataclasses.replace(
-            self, delay_kind="lognormal", delay_mean=10.0, delay_variance=4.0,
-            delay_pareto_scale=5.0, delay_pareto_alpha=1.5, drop_prob=0.0,
-            max_clock=0, delta=20, gamma=2.0)
+        test suite's XLA compile count down.
+
+        With the scenario plane on (``scenario=True``), ``commit_chain``
+        is ALSO normalized out: the commit rule reads the per-slot traced
+        ``sc_commit`` instead of the static knob, so 2-chain and 3-chain
+        slots share one executable — the key gets strictly coarser, which
+        is what collapses the AOT executable store for scenario sweeps."""
+        out = dataclasses.replace(
+            self, drop_prob=0.0, max_clock=0, delta=20, gamma=2.0,
+            **DELAY_KEY_DEFAULTS)
+        if self.scenario:
+            out = dataclasses.replace(out, commit_chain=3)
+        return out
 
     def delay_table(self) -> np.ndarray:
         if self.delay_kind == "pareto":
@@ -276,6 +316,54 @@ class SimParams:
 
 def _zeros(shape, dtype=jnp.int32):
     return jnp.zeros(shape, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Per-slot traced scenario plane (SimParams.scenario; serve/scenario.py).
+# ---------------------------------------------------------------------------
+
+
+def sc_delay_init(p: SimParams):
+    """Knob-default ``sc_delay`` row: the params' own delay table (so a
+    plain init is bit-identical to the static engine), [0] when off."""
+    if not p.scenario:
+        return jnp.zeros((0,), jnp.int32)
+    return jnp.asarray(p.delay_table(), jnp.int32)
+
+
+def sc_commit_init(p: SimParams):
+    """Knob-default ``sc_commit`` row: the params' static commit_chain."""
+    if not p.scenario:
+        return jnp.zeros((0,), jnp.int32)
+    return jnp.full((1,), p.commit_chain, jnp.int32)
+
+
+class TracedParams:
+    """A :class:`SimParams` view whose ``commit_chain`` is a traced
+    per-instance scalar — the scenario plane's ``sc_commit`` row.
+
+    The engines construct one per step (inside the trace, from the state
+    leaf) and hand it to the protocol code in core/store.py, core/node.py,
+    and core/data_sync.py in place of the static params; every other
+    attribute (shapes, capacities, lowering knobs, the bound methods like
+    ``structural``/``delay_table``) delegates to the static params, so the
+    whole call graph needs no signature changes.  The commit-rule sites
+    branch on ``isinstance(commit_chain, int)``: a static int keeps
+    today's Python-unrolled walk exactly; a tracer takes the
+    2-vs-3-chain select form (both depths computed, the per-slot value
+    picks — bit-identical per slot to the static graph of that depth).
+    Never hashable and never a jit key: it exists only inside a trace."""
+
+    __slots__ = ("_p", "commit_chain")
+
+    def __init__(self, p: SimParams, commit_chain):
+        self._p = p
+        self.commit_chain = commit_chain
+
+    def __getattr__(self, name):
+        return getattr(self._p, name)
+
+    __hash__ = None  # type: ignore[assignment]  # never a cache/jit key
 
 
 # ---------------------------------------------------------------------------
@@ -739,3 +827,12 @@ class SimState:
     # stream.WD_SLOTS.  Trip counts ride the fleet digest on the
     # run_sharded halt poll, so anomalies surface live.
     wd: Array           # [WD] int32
+    # Per-slot traced scenario plane (SimParams.scenario; serve/): the
+    # instance's OWN delay quantile table and commit-chain selector ride
+    # as state, so one executable serves heterogeneous scenarios and the
+    # admission path installs a new scenario with a device write, never a
+    # recompile.  Both zero-width when the scenario plane is off; READ-
+    # ONLY config — the step passes them through untouched (pinned by the
+    # graph audit's scenario R6 arm).
+    sc_delay: Array     # [T] int32 delay table row ([0] when off)
+    sc_commit: Array    # [1] int32 commit-chain (2|3; [0] when off)

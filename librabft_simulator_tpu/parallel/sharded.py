@@ -55,6 +55,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from ..core import config
+from ..core import types
 from ..core.types import SimParams
 from ..sim import simulator as sim_ops
 from ..telemetry import ledger as tledger
@@ -203,9 +204,18 @@ def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
             "author-sharded quorums")
     # Normalize the pure-runtime fields (they live in SimState, not the
     # graph) so horizon/drop sweeps share one cache entry; delay/delta/
-    # gamma stay in the key — they parameterize the baked tables.
+    # gamma stay in the key — they parameterize the baked tables.  With
+    # the scenario plane armed the delay table rides IN STATE (per slot)
+    # and the commit rule reads the traced sc_commit selector, so the key
+    # gets strictly coarser: delay_* and commit_chain are normalized out
+    # exactly as ``structural()`` does, and one sharded executable serves
+    # every admitted scenario config — the resident fleet service's
+    # no-recompile-on-admission guarantee (serve/service.py).
     key_p = dataclasses.replace(xops.resolve_params(p), max_clock=0,
                                 drop_prob=0.0)
+    if key_p.scenario:
+        key_p = dataclasses.replace(
+            key_p, commit_chain=3, **types.DELAY_KEY_DEFAULTS)
     inner = _cached_sharded_run_fn(key_p, mesh, num_steps, eng, wrap)
     eng_name = "sharded/" + ("lane" if eng is not sim_ops else "serial")
     # AOT executable store (utils/aot.py): consult before tracing — see

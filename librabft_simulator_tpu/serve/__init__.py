@@ -1,0 +1,22 @@
+"""Resident fleet service: continuous batching for simulation-as-a-service.
+
+Three layers (see README "Resident fleet service"):
+
+* :mod:`.scenario` — the per-slot traced scenario plane: the knobs that
+  used to be compile-time ``SimParams`` fields (delay distribution, drop
+  rate, Byzantine schedule, rng seed, commit rule, horizon) as fixed-shape
+  per-instance tensors, so ONE compiled executable serves a heterogeneous
+  fleet of scenarios.
+* :mod:`.service` — :class:`~librabft_simulator_tpu.serve.service.ResidentFleet`:
+  the never-exiting double-buffered chunk loop with an admission queue
+  (new scenarios install into *halted* slots via one batched donated
+  device write — no recompile) and per-request result egress.
+* :mod:`.api` — :class:`~librabft_simulator_tpu.serve.api.FleetService`:
+  submit/poll/drain as a library API, NDJSON request/result front-end
+  (scripts/fleet_serve.py), graceful drain, and checkpoint-based
+  preemption/eviction.
+"""
+
+from .scenario import ScenarioPlane, ScenarioSpec  # noqa: F401
+from .service import ResidentFleet, ScenarioRequest  # noqa: F401
+from .api import FleetService, load_requests  # noqa: F401

@@ -1,0 +1,148 @@
+"""Serving API: submit / poll / drain as a library, NDJSON at the edges.
+
+:class:`FleetService` is the operator-facing wrapper over
+:class:`~librabft_simulator_tpu.serve.service.ResidentFleet`: env-knob
+defaults (``LIBRABFT_SERVE_SLOTS`` / ``LIBRABFT_SERVE_CHUNK`` /
+``LIBRABFT_SERVE_OUT``), NDJSON request-file ingestion
+(:func:`load_requests` — the ``scripts/fleet_serve.py`` front-end), result
+emission, and checkpoint-based preemption.
+
+Request schema (one JSON object per line)::
+
+    {"id": "req-1", "delay_kind": "pareto", "delay_pareto_scale": 2.0,
+     "drop_prob": 0.05, "commit_chain": 2, "byz_kind": "silent",
+     "byz_f": 1, "seed": 7, "max_clock": 1200}
+
+Every field except ``id`` is a :class:`serve.scenario.ScenarioSpec` field
+(all optional — defaults are the base params' scenario); unknown fields
+fail loud.  Results stream back as ``kind="request" event="egressed"``
+rows on the service NDJSON (and from :meth:`FleetService.drain`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..core.types import SimParams
+from . import scenario as sc
+from .service import ResidentFleet
+
+#: Env knobs (registered in audit/knobs.py; README table generated).
+SLOTS_ENV = "LIBRABFT_SERVE_SLOTS"
+CHUNK_ENV = "LIBRABFT_SERVE_CHUNK"
+OUT_ENV = "LIBRABFT_SERVE_OUT"
+
+
+def _int_env(name: str, default: int) -> int:
+    env = os.environ.get(name, "").strip()
+    if not env:
+        return default
+    try:
+        v = int(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r}: want a positive integer")
+    if v < 1:
+        raise ValueError(f"{name}={env!r}: want a positive integer")
+    return v
+
+
+def load_requests(path: str):
+    """Read an NDJSON request file -> ``[(id, ScenarioSpec), ...]``.
+
+    ``id`` defaults to the 1-based line number; malformed lines and
+    unknown scenario fields raise with the offending line number (a typo
+    must not silently run the default scenario)."""
+    out = []
+    seen: dict[str, int] = {}
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON ({e})") from None
+            if not isinstance(obj, dict):
+                raise ValueError(f"{path}:{i}: want a JSON object per line")
+            rid = str(obj.pop("id", i))
+            if rid in seen:
+                raise ValueError(
+                    f"{path}:{i}: duplicate request id {rid!r} (first at "
+                    f"line {seen[rid]}); ids key the result stream")
+            seen[rid] = i
+            try:
+                spec = sc.ScenarioSpec.from_dict(obj)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"{path}:{i}: {e}") from None
+            out.append((rid, spec))
+    if not out:
+        raise ValueError(f"{path}: no requests (empty or comments only)")
+    return out
+
+
+class FleetService:
+    """submit/poll/drain over a resident fleet, with env-default config.
+
+    ``base_params`` fixes the structural shape every scenario shares
+    (n_nodes, capacities, engine lowering knobs); per-request knobs ride
+    the scenario plane.  One instance = one resident executable."""
+
+    def __init__(self, base_params: SimParams | None = None,
+                 slots: int | None = None, chunk: int | None = None,
+                 mesh=None, engine=None, out: str | None = None):
+        self.p = base_params if base_params is not None else SimParams(
+            n_nodes=4)
+        self.fleet = ResidentFleet(
+            self.p,
+            slots=slots if slots is not None else _int_env(SLOTS_ENV, 8),
+            chunk=chunk if chunk is not None else _int_env(CHUNK_ENV, 64),
+            mesh=mesh, engine=engine,
+            out=out if out is not None else (os.environ.get(OUT_ENV)
+                                             or None))
+
+    def submit(self, spec, request_id: str | None = None) -> str:
+        return self.fleet.submit(spec, request_id=request_id)
+
+    def submit_file(self, path: str) -> list[str]:
+        """Queue every request of an NDJSON file; returns the ids."""
+        return [self.fleet.submit(spec, request_id=rid)
+                for rid, spec in load_requests(path)]
+
+    def poll(self, request_id: str) -> dict:
+        return self.fleet.poll(request_id)
+
+    def serve(self, max_chunks: int | None = None):
+        kw = {} if max_chunks is None else {"max_chunks": max_chunks}
+        self.fleet.serve(**kw)
+        return self
+
+    def drain(self, max_chunks: int | None = None) -> dict:
+        kw = {} if max_chunks is None else {"max_chunks": max_chunks}
+        return self.fleet.drain(**kw)
+
+    def preempt(self, path: str) -> None:
+        """Checkpoint-based eviction: persist the resident state + serve
+        bookkeeping and release the device memory claim to the caller."""
+        self.fleet.save(path)
+
+    @classmethod
+    def resume(cls, path: str, base_params: SimParams, mesh=None,
+               engine=None, out: str | None = None) -> "FleetService":
+        svc = cls.__new__(cls)
+        svc.p = base_params
+        svc.fleet = ResidentFleet.restore(
+            path, base_params, mesh=mesh, engine=engine,
+            out=out if out is not None else (os.environ.get(OUT_ENV)
+                                             or None))
+        return svc
+
+    def close(self) -> None:
+        self.fleet.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

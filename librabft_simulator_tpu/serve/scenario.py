@@ -1,0 +1,247 @@
+"""Per-slot scenario planes: compile-time knobs re-expressed as traced data.
+
+The batch dimension already multiplies *instances*; this module makes it
+multiply *scenarios*.  Every per-instance knob that used to be a static
+``SimParams`` compile key — the delay distribution (its quantile table
+becomes a per-slot ``[T]`` int32 row, ``SimState.sc_delay``), the commit
+rule (a per-slot 2-vs-3-chain selector, ``SimState.sc_commit``, consumed
+by the traced select in core/store.py via ``types.TracedParams``), the
+Byzantine schedule (``sim/byzantine.py`` ``SCHEDULES``, realized as the
+three per-instance masks the engines already carry), drop rate, rng seed,
+and horizon — is carried in one fixed-shape :class:`ScenarioPlane` row per
+slot.  With ``SimParams.scenario=True`` the engines read these rows instead
+of the static knobs, so:
+
+* the structural compile key shrinks to shapes + engine flavor
+  (``SimParams.structural()`` normalizes ``commit_chain`` out; the sharded
+  runner stops keying on delay fields) — ONE executable serves the whole
+  scenario family, which collapses the AOT executable store;
+* installing a new scenario into a fleet slot is a device write
+  (:func:`install_rows` — a single batched donated dispatch of pure
+  elementwise selects; R1/R2-clean, no recompile), which is what the
+  resident fleet service's admission queue runs on.
+
+Per-slot trajectories are bit-identical to a dedicated static run of the
+same scenario (tests/test_serve.py; FUZZ_SCENARIO campaigns), because every
+knob's effect routes through the same value path — the plane only changes
+WHERE the value comes from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core.types import SimParams
+from ..sim import byzantine
+from ..sim import simulator as sim_ops
+from ..utils import hashing as H
+from ..utils.quantile import TABLE_BITS
+
+I32 = jnp.int32
+
+
+@struct.dataclass
+class ScenarioPlane:
+    """One scenario per row: the traced per-slot knob tensors.
+
+    Unbatched rows describe one slot; a leading ``[B]`` dim describes a
+    fleet.  All int/uint/bool by design (the R2 discipline)."""
+
+    seed: jnp.ndarray            # uint32 instance rng stream
+    delay_table: jnp.ndarray     # [T] int32 delay quantile table
+    drop_u32: jnp.ndarray        # uint32 drop threshold
+    max_clock: jnp.ndarray       # int32 horizon
+    commit_chain: jnp.ndarray    # int32: 2 (HotStuff-style) | 3 (LibraBFTv2)
+    byz_equivocate: jnp.ndarray  # [N] bool
+    byz_silent: jnp.ndarray      # [N] bool
+    byz_forge_qc: jnp.ndarray    # [N] bool
+
+
+#: The scenario-settable SimParams fields a spec overrides on its base
+#: (everything else — shapes, engine lowering — is structural and shared).
+_SPEC_PARAM_FIELDS = ("delay_kind", "delay_mean", "delay_variance",
+                      "delay_pareto_scale", "delay_pareto_alpha",
+                      "drop_prob", "commit_chain", "max_clock")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """A host-side scenario description — the request payload unit.
+
+    ``to_params(base)`` gives the *dedicated-run equivalent*: the static
+    ``SimParams`` a batch-mode run of exactly this scenario would use
+    (scenario plane off) — the oracle/parity reference every per-slot
+    pin compares against.  ``plane_row(base)`` gives the traced form."""
+
+    delay_kind: str = "lognormal"
+    delay_mean: float = 10.0
+    delay_variance: float = 4.0
+    delay_pareto_scale: float = 5.0
+    delay_pareto_alpha: float = 1.5
+    drop_prob: float = 0.0
+    commit_chain: int = 3
+    max_clock: int = 1000
+    byz_kind: str = "honest"      # one of sim/byzantine.SCHEDULES
+    byz_f: int = 0
+    byz_authors: tuple | None = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.byz_kind not in byzantine.SCHEDULES:
+            raise ValueError(
+                f"unknown Byzantine schedule {self.byz_kind!r}; want one "
+                f"of {byzantine.SCHEDULES}")
+        if self.commit_chain not in (2, 3):
+            raise ValueError(
+                f"commit_chain must be 2 or 3, got {self.commit_chain}")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScenarioSpec":
+        """Build from an NDJSON request row; unknown keys fail loud (a
+        typo'd knob must not silently run the default scenario)."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(
+                f"unknown scenario field(s) {sorted(extra)}; known: "
+                f"{sorted(known)}")
+        if "byz_authors" in d and d["byz_authors"] is not None:
+            d = dict(d, byz_authors=tuple(d["byz_authors"]))
+        return cls(**d)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_params(self, base: SimParams) -> SimParams:
+        """The static params of a dedicated batch-mode run of this
+        scenario (scenario plane OFF — the bit-parity reference)."""
+        return dataclasses.replace(
+            base, scenario=False,
+            **{f: getattr(self, f) for f in _SPEC_PARAM_FIELDS})
+
+    def byz_masks(self, base: SimParams):
+        return byzantine.schedule_masks(
+            base, self.byz_kind, self.byz_f,
+            list(self.byz_authors) if self.byz_authors is not None else None)
+
+    def plane_row(self, base: SimParams) -> ScenarioPlane:
+        """This scenario as one (unbatched) plane row."""
+        ded = self.to_params(base)
+        eq, silent, forge = self.byz_masks(base)
+        return ScenarioPlane(
+            seed=jnp.uint32(self.seed & 0xFFFFFFFF),
+            delay_table=jnp.asarray(ded.delay_table(), I32),
+            drop_u32=jnp.uint32(ded.drop_u32),
+            max_clock=jnp.asarray(ded.max_clock, I32),
+            commit_chain=jnp.asarray(ded.commit_chain, I32),
+            byz_equivocate=eq, byz_silent=silent, byz_forge_qc=forge,
+        )
+
+
+def default_row(p: SimParams, seed: int | jnp.ndarray = 0) -> ScenarioPlane:
+    """The knob-default row: the scenario the base params themselves
+    describe (a fleet of these is bit-identical to a plain static run)."""
+    n = p.n_nodes
+    z = jnp.zeros((n,), jnp.bool_)
+    return ScenarioPlane(
+        seed=jnp.asarray(seed).astype(jnp.uint32),
+        delay_table=jnp.asarray(p.delay_table(), I32),
+        drop_u32=jnp.uint32(p.drop_u32),
+        max_clock=jnp.asarray(p.max_clock, I32),
+        commit_chain=jnp.asarray(p.commit_chain, I32),
+        byz_equivocate=z, byz_silent=z, byz_forge_qc=z,
+    )
+
+
+def stack_rows(rows) -> ScenarioPlane:
+    """Stack unbatched rows into a ``[B]``-leading plane."""
+    rows = list(rows)
+    if not rows:
+        raise ValueError("stack_rows needs at least one scenario row")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+
+
+def _require_scenario(p: SimParams) -> None:
+    if not p.scenario:
+        raise ValueError(
+            "scenario-plane state needs SimParams.scenario=True (the "
+            "sc_delay/sc_commit leaves are zero-width otherwise); arm it "
+            "with dataclasses.replace(p, scenario=True)")
+
+
+def init_slot(p: SimParams, row: ScenarioPlane, engine=None):
+    """Fresh engine state for ONE slot running ``row``'s scenario.
+
+    Exactly :func:`sim.simulator.init_state` (or the lane engine's) for
+    the scenario's dedicated params: the startup-time draws replay the
+    same formula against the ROW's delay table, and the row's knobs land
+    in the state leaves the step actually reads (max_clock / drop_u32 /
+    byz masks were per-instance state already; sc_delay / sc_commit are
+    the new traced rows).  jit/vmap-friendly — :func:`init_rows` vmaps it,
+    and the admission path calls it per request."""
+    _require_scenario(p)
+    eng = engine if engine is not None else sim_ops
+    st = eng.init_state(
+        p, row.seed,
+        byz_equivocate=row.byz_equivocate,
+        byz_silent=row.byz_silent,
+        byz_forge_qc=row.byz_forge_qc)
+    seed = jnp.asarray(row.seed).astype(jnp.uint32)
+    draws = jax.vmap(lambda c: H.rng_u32(seed, c.astype(jnp.uint32)))(
+        jnp.arange(p.n_nodes))
+    startup = (row.delay_table[(draws >> (32 - TABLE_BITS)).astype(I32)]
+               + 1).astype(I32)
+    return st.replace(
+        startup=startup,
+        timer_time=startup,
+        max_clock=jnp.asarray(row.max_clock, I32),
+        drop_u32=jnp.asarray(row.drop_u32, jnp.uint32),
+        sc_delay=jnp.asarray(row.delay_table, I32),
+        sc_commit=jnp.reshape(jnp.asarray(row.commit_chain, I32), (1,)),
+    )
+
+
+def init_rows(p: SimParams, plane: ScenarioPlane, engine=None):
+    """Batched heterogeneous fleet: one engine state per plane row."""
+    _require_scenario(p)
+    return jax.vmap(lambda r: init_slot(p, r, engine=engine))(plane)
+
+
+def init_specs(p: SimParams, specs, seeds=None, engine=None):
+    """Heterogeneous fleet straight from :class:`ScenarioSpec`s (seeds
+    default to each spec's own ``seed`` field)."""
+    specs = list(specs)
+    rows = [s.plane_row(p) for s in specs]
+    if seeds is not None:
+        rows = [r.replace(seed=jnp.uint32(int(sd) & 0xFFFFFFFF))
+                for r, sd in zip(rows, seeds)]
+    return init_rows(p, stack_rows(rows), engine=engine)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def install_rows(st, mask, donor):
+    """THE admission write: replace the masked slots of a batched fleet
+    state with the donor's rows — one dispatched program, input donated
+    (the resident state is threaded in place), and every leaf write a
+    pure broadcast-select (``where(mask, donor, old)``): no scatters, no
+    gathers, int-only — the R1/R2-clean form by construction, and it
+    shards trivially when ``st``/``donor`` are dp-sharded (elementwise on
+    matching shardings; no resharding inserted).
+
+    ``mask``: ``[B]`` bool (True = install).  ``donor``: a fleet-shaped
+    state tree whose masked rows hold the freshly initialised admitted
+    scenarios (unmasked rows are ignored).  Halted slots are observably
+    inert (every engine write is live-gated), so installing over them
+    between chunks never perturbs live slots — pinned bit-exactly by
+    tests/test_serve.py."""
+    def put(old, new):
+        m = mask.reshape((mask.shape[0],) + (1,) * (old.ndim - 1))
+        return jnp.where(m, new, old)
+
+    return jax.tree.map(put, st, donor)

@@ -1,0 +1,516 @@
+"""ResidentFleet: the never-exiting fleet loop with an admission queue.
+
+A batch-mode fleet run is compile → run to global halt → land results; the
+production regime ("millions of users" submitting scenarios) is the
+inference-serving one — continuous batching:
+
+* ONE resident compiled chunk executable stays hot
+  (``parallel/sharded.make_sharded_run_fn`` on scenario-armed params: the
+  structural key covers every scenario the plane can express, so a serve
+  session admitting arbitrarily many distinct configs shows exactly one
+  fleet-chunk compile — or aot-hit — on the compile ledger);
+* the host loop is ``run_sharded``'s double-buffered discipline (chunk
+  k+1 dispatches before chunk k's ``[13]`` digest is polled — still the
+  ONE blocking fetch per chunk, via ``sharded._poll_digest``) but never
+  exits: between chunks it inspects the polled digest's ``halted`` count,
+  egresses finished slots' results (request-tagged, landed host-side with
+  one gather per leaf over the finished rows), pops pending
+  :class:`ScenarioRequest`s, and installs their scenario rows + fresh init
+  state into the freed slots via :func:`serve.scenario.install_rows` —
+  one batched donated device write, no recompile;
+* request lifecycle (submit → admit → first chunk → egress) is recorded
+  as runtime-ledger ``admit``/``egress`` spans and as ``kind="request"``
+  rows on the digest NDJSON stream, so ``fleet_watch --serve`` follows
+  one file for queue depth, slot occupancy, and per-request ttfc.
+
+Halted slots are observably inert (every engine write is live-gated — the
+pre-halted-padding idiom), so installing over them between chunks leaves
+every live slot's trajectory bit-identical to an undisturbed run
+(tests/test_serve.py pins this leaf-for-leaf).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import time
+from collections import deque
+
+import jax
+import numpy as np
+
+from ..core.types import SimParams
+from ..parallel import mesh as mesh_ops
+from ..parallel import sharded
+from ..sim import byzantine
+from ..sim import simulator as sim_ops
+from ..telemetry import ledger as tledger
+from ..telemetry import stream as tstream
+from . import scenario as sc
+
+#: Per-serve-call chunk ceiling: a runaway scenario (horizon never reached
+#: because the caller admitted an effectively-unbounded max_clock) must not
+#: wedge the host loop forever.
+MAX_CHUNKS_DEFAULT = 10_000
+
+
+@dataclasses.dataclass
+class ScenarioRequest:
+    """One queued scenario: id + spec + host-side lifecycle timestamps."""
+
+    request_id: str
+    spec: sc.ScenarioSpec
+    submitted_t: float = 0.0
+    admitted_t: float | None = None
+    first_chunk_t: float | None = None
+    egressed_t: float | None = None
+    slot: int | None = None
+    #: Global index of the first dispatched chunk whose INPUT holds this
+    #: request's installed rows (admission at a boundary lands in the
+    #: in-flight chunk's output, so the request's first executed chunk is
+    #: the NEXT dispatch) — first_chunk/ttfc stamp only once that chunk
+    #: is polled, and _boundary uses it to ignore the digest lag.
+    admit_dispatch: int | None = None
+
+    @property
+    def status(self) -> str:
+        if self.egressed_t is not None:
+            return "egressed"
+        if self.admitted_t is not None:
+            return "admitted"
+        return "pending"
+
+    def ttfc_s(self) -> float | None:
+        """Admission-to-first-polled-chunk latency (the serving ttfc)."""
+        if self.admitted_t is None or self.first_chunk_t is None:
+            return None
+        return round(self.first_chunk_t - self.admitted_t, 6)
+
+
+class ResidentFleet:
+    """A resident, continuously-batched scenario-serving fleet.
+
+    ``slots`` fleet slots (rounded up to the mesh size) start halted and
+    free; :meth:`submit` queues scenarios; :meth:`serve` pumps the chunk
+    loop until the queue and fleet drain (or ``max_chunks``); results
+    land in :attr:`results` keyed by request id.  ``out`` streams the
+    digest timeline + request rows as NDJSON for ``fleet_watch --serve``.
+    """
+
+    def __init__(self, p: SimParams, slots: int = 8, mesh=None,
+                 chunk: int = 64, engine=None, out=None, meta=None,
+                 fresh_state: bool = True):
+        self.engine = engine if engine is not None else sim_ops
+        self.p = dataclasses.replace(p, scenario=True)
+        self.mesh = mesh if mesh is not None else mesh_ops.make_mesh(n_dp=1)
+        self.slots = -(-slots // self.mesh.size) * self.mesh.size
+        self.chunk = int(chunk)
+        # THE resident executable: structural key only (scenario plane
+        # armed), built once — every admission reuses it.
+        self._run = sharded.make_sharded_run_fn(
+            self.p, self.mesh, self.chunk, engine=self.engine)
+        # All slots start as pre-halted knob-default rows: free capacity,
+        # observably inert until a scenario is installed.
+        # (``fresh_state=False`` is restore()'s internal path: the
+        # checkpoint replaces ``_st`` immediately, so the fleet-sized init
+        # dispatch + placement here would be dead work.)
+        if fresh_state:
+            st = self.engine.init_batch(
+                self.p, sharded.fleet_seeds(0x5EAF, self.slots))
+            st = st.replace(halted=np.ones((self.slots,), bool))
+            self._st = mesh_ops.shard_batch(
+                self.mesh, sim_ops.dedupe_buffers(st))
+        else:
+            self._st = None
+        self._pending: deque[ScenarioRequest] = deque()
+        self._active: dict[int, ScenarioRequest] = {}
+        self.requests: dict[str, ScenarioRequest] = {}
+        self.results: dict[str, dict] = {}
+        self.chunks_polled = 0
+        # Global dispatch counter: every dispatched chunk gets polled by
+        # the end of a serve() call, so this equals chunks_polled between
+        # calls; mid-loop they differ by the in-flight chunk, and the
+        # dispatch-span labels / admit_dispatch indices ride this one
+        # (chunks_polled alone would mislabel dispatches issued while a
+        # poll is still pending).
+        self._dispatched = 0
+        self._ids = itertools.count()
+        self._t0 = time.perf_counter()
+        self._recorder = tstream.TimelineRecorder(
+            self.p, total_instances=self.slots, out=out,
+            meta=dict({"serve": True, "chunk": self.chunk,
+                       "slots": self.slots}, **(meta or {})))
+        self._lg = tledger.get()
+        self._rid = self._lg.new_run(
+            "resident_fleet", devices=self.mesh.size, instances=self.slots,
+            pipeline=True, chunk_steps=self.chunk)
+
+    # ------------------------------------------------------------------
+    # Submission / inspection.
+    # ------------------------------------------------------------------
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def submit(self, spec, request_id: str | None = None) -> str:
+        """Queue one scenario; returns its request id."""
+        if isinstance(spec, dict):
+            spec = sc.ScenarioSpec.from_dict(spec)
+        if request_id is not None:
+            rid = request_id
+        else:
+            # Skip past restored ids: a resumed service's counter restarts,
+            # and a collision would silently overwrite the old result.
+            rid = f"r{next(self._ids)}"
+            while rid in self.requests:
+                rid = f"r{next(self._ids)}"
+        if rid in self.requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        req = ScenarioRequest(rid, spec, submitted_t=self._now())
+        self._pending.append(req)
+        self.requests[rid] = req
+        self._emit_request(req, "submitted")
+        return rid
+
+    def poll(self, request_id: str) -> dict:
+        """Status (and result, once egressed) of one request."""
+        req = self.requests[request_id]
+        out = {"request_id": request_id, "status": req.status,
+               "slot": req.slot, "ttfc_s": req.ttfc_s()}
+        if request_id in self.results:
+            out["result"] = self.results[request_id]
+        return out
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def occupancy(self) -> dict:
+        return {"slots": self.slots, "active": len(self._active),
+                "free": self.slots - len(self._active),
+                "pending": len(self._pending),
+                "egressed": len(self.results)}
+
+    def _emit_request(self, req: ScenarioRequest, event: str,
+                      **extra) -> None:
+        self._recorder.emit({
+            "kind": "request", "event": event, "id": req.request_id,
+            "t_s": round(self._now(), 6), "slot": req.slot,
+            "status": req.status, "ttfc_s": req.ttfc_s(),
+            **self.occupancy(), **extra})
+
+    # ------------------------------------------------------------------
+    # The resident loop.
+    # ------------------------------------------------------------------
+
+    def serve(self, max_chunks: int = MAX_CHUNKS_DEFAULT):
+        """Pump the double-buffered chunk loop until the admission queue
+        AND the fleet drain (graceful drain), or ``max_chunks`` chunks.
+        Safe to call repeatedly — the resident state persists between
+        calls (that is the point)."""
+        # Pre-loop admission: free capacity is host-known, no fetch.
+        # self._st tracks the newest valid handle at every step — the
+        # chunk runner and install_rows both DONATE their input, so a
+        # stale reference after an exception would point at freed
+        # buffers.
+        self._st = self._admit(self._st)
+        with self._lg.span(tledger.DISPATCH, run=self._rid,
+                           chunk=self._dispatched):
+            self._st, dg = self._run(self._st)
+        self._dispatched += 1
+        dispatched = 1
+        while dispatched < max_chunks and (self._pending or self._active):
+            with self._lg.span(tledger.DISPATCH, run=self._rid,
+                               chunk=self._dispatched):
+                st_next, dg_next = self._run(self._st)  # dispatch k+1 ...
+            self._dispatched += 1
+            self._st = st_next
+            d = self._poll_one(dg)                # ... then poll chunk k
+            dg = dg_next
+            dispatched += 1
+            self._st = self._boundary(self._st, d)
+        d = self._poll_one(dg)                    # the final in-flight chunk
+        self._st = self._boundary(self._st, d)
+        return self
+
+    def drain(self, max_chunks: int = MAX_CHUNKS_DEFAULT) -> dict:
+        """Graceful drain: serve until everything queued has egressed;
+        returns ``results``."""
+        self.serve(max_chunks=max_chunks)
+        if self._pending or self._active:
+            raise RuntimeError(
+                f"drain incomplete after {max_chunks} chunks: "
+                f"{len(self._pending)} pending, {len(self._active)} active "
+                "(raise max_chunks, or a scenario's max_clock horizon is "
+                "effectively unbounded)")
+        return self.results
+
+    def _poll_one(self, dg) -> dict:
+        """The one blocking [13]-digest fetch per chunk (the run_sharded
+        poll contract, same ``_poll_digest`` entry point the
+        monkeypatched-device_get tests pin)."""
+        with self._lg.span(tledger.POLL, run=self._rid,
+                           chunk=self.chunks_polled):
+            vec = sharded._poll_digest(dg)
+        self.chunks_polled += 1
+        row = self._recorder.record(
+            vec, steps=self.chunks_polled * self.chunk)
+        t = self._now()
+        # first_chunk stamps only when a chunk that actually EXECUTED the
+        # request's rows has been polled: a boundary admission lands in
+        # the in-flight chunk's output, so the poll of that chunk (where
+        # the slot still ran halted) must not count.
+        polled = self.chunks_polled - 1
+        for req in self._active.values():
+            if (req.first_chunk_t is None and req.admitted_t is not None
+                    and polled >= (req.admit_dispatch or 0)):
+                req.first_chunk_t = t
+                self._emit_request(req, "first_chunk")
+        return row
+
+    def _boundary(self, st, digest_row: dict):
+        """Between-chunks work: egress finished slots, admit pending.
+
+        The digest's ``halted`` count is the trigger — only when it says
+        some ACTIVE slot halted (halted > free slots) does the host pay
+        the one [slots] bool halted-plane fetch that identifies which;
+        steady-state chunks stay digest-only."""
+        free_before = self.slots - len(self._active)
+        # Digest lag: the polled chunk predates any admission issued after
+        # its dispatch, so slots admitted since then are still counted
+        # halted by this digest — subtract them or every admission wave
+        # would trigger one spurious (and pipeline-stalling) halted-plane
+        # fetch on the in-flight state.
+        polled = self.chunks_polled - 1
+        stale = sum(1 for r in self._active.values()
+                    if (r.admit_dispatch or 0) > polled)
+        finished = int(digest_row["halted"]) - free_before - stale
+        if finished > 0 and self._active:
+            st = self._egress(st)
+        if self._pending and len(self._active) < self.slots:
+            st = self._admit(st)
+        return st
+
+    # ------------------------------------------------------------------
+    # Egress.
+    # ------------------------------------------------------------------
+
+    def _egress(self, st):
+        with self._lg.span(tledger.EGRESS, run=self._rid):
+            halted = np.asarray(jax.device_get(st.halted))
+            done = [s for s, req in sorted(self._active.items())
+                    if bool(halted[s])]
+            if not done:
+                return st
+            idx = np.asarray(done, np.int32)
+            # Land ONLY the finished rows on host: one gather per leaf
+            # over the k finished slots (the unpad discipline — never the
+            # whole fleet).
+            rows = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x[idx])), st)
+            for j, slot in enumerate(done):
+                req = self._active.pop(slot)
+                # A scenario that halts within its first executed chunk
+                # can reach egress (this fetch reads the freshest state)
+                # before _poll_one's stamp condition is met — the slot
+                # demonstrably ran, so stamp first_chunk here rather than
+                # egress a request whose lifecycle says it never started.
+                if req.first_chunk_t is None and req.admitted_t is not None:
+                    req.first_chunk_t = self._now()
+                    self._emit_request(req, "first_chunk")
+                req.egressed_t = self._now()
+                row = jax.tree.map(lambda x, jj=j: x[jj], rows)
+                self.results[req.request_id] = self._result_of(req, row)
+                self._emit_request(
+                    req, "egressed",
+                    latency_s=round(req.egressed_t - req.submitted_t, 6),
+                    result=self.results[req.request_id])
+        return st
+
+    def _result_of(self, req: ScenarioRequest, row) -> dict:
+        """Per-request result summary from one landed slot row."""
+        p = self.p
+        eq, silent, forge = req.spec.byz_masks(p)
+        byz_any = (np.asarray(eq) | np.asarray(silent) | np.asarray(forge))
+        st1 = jax.tree.map(lambda x: np.asarray(x)[None], row)
+        safe = bool(byzantine.check_safety_reference(
+            st1, honest_mask=~byz_any)[0])
+        out = {
+            "request_id": req.request_id,
+            "spec": req.spec.to_dict(),
+            "slot": req.slot,
+            "events": int(row.n_events),
+            "clock": int(row.clock),
+            "commits": [int(c) for c in np.asarray(row.ctx.commit_count)],
+            "committed_round_max": int(np.max(np.asarray(row.store.hcr))),
+            "msgs_sent": int(row.n_msgs_sent),
+            "msgs_dropped": int(row.n_msgs_dropped),
+            "safe": safe,
+            "ttfc_s": req.ttfc_s(),
+        }
+        if p.telemetry:
+            from ..telemetry import report as tel_report
+
+            out["telemetry"] = tel_report.metrics_dict(p, row)
+        return out
+
+    # ------------------------------------------------------------------
+    # Admission.
+    # ------------------------------------------------------------------
+
+    def _admit(self, st):
+        """Install up to free-slot-count pending scenarios: fresh init
+        rows assembled host-side into a fleet-shaped donor, then ONE
+        batched donated device write (scenario.install_rows) — the
+        resident executable is never rebuilt.
+
+        The donor is deliberately FLEET-shaped (not k admitted rows): a
+        k-sized donor would bake k into the install executable's shape
+        key and recompile per distinct admission width, trading a
+        bounded [B]-sized H2D copy per admission wave for exactly the
+        per-config compile storm this subsystem exists to kill."""
+        free = [s for s in range(self.slots) if s not in self._active]
+        k = min(len(free), len(self._pending))
+        if k == 0:
+            return st
+        with self._lg.span(tledger.ADMIT, run=self._rid, requests=k):
+            mask = np.zeros((self.slots,), bool)
+            donor = None
+            admitted = []
+            for slot in free[:k]:
+                req = self._pending.popleft()
+                req.slot = slot
+                row_st = jax.tree.map(
+                    lambda x: np.asarray(jax.device_get(x)),
+                    sc.init_slot(self.p, req.spec.plane_row(self.p),
+                                 engine=self.engine))
+                if donor is None:
+                    donor = jax.tree.map(
+                        lambda x: np.zeros((self.slots,) + x.shape,
+                                           x.dtype), row_st)
+
+                def place(d, r, s=slot):
+                    d[s] = r
+                    return d
+
+                donor = jax.tree.map(place, donor, row_st)
+                mask[slot] = True
+                self._active[slot] = req
+                admitted.append(req)
+            donor = mesh_ops.shard_batch(self.mesh, donor)
+            mask_dev = mesh_ops.shard_batch(self.mesh, mask)
+            st = sc.install_rows(st, mask_dev, donor)
+            t = self._now()
+            for req in admitted:
+                req.admitted_t = t
+                req.admit_dispatch = self._dispatched
+                self._emit_request(req, "admitted")
+        return st
+
+    # ------------------------------------------------------------------
+    # Checkpoint-based preemption / eviction.
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Preemption-safe eviction: the resident device state checkpoints
+        through sim/checkpoint.py and the serve bookkeeping (slot table,
+        pending specs, finished results) lands in a JSON sidecar — a
+        preempted service resumes with :meth:`ResidentFleet.restore` and
+        every live slot continues bit-identically (the checkpoint
+        round-trip guarantee)."""
+        from ..sim import checkpoint as ckpt
+
+        host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)),
+                            self._st)
+        ckpt.save(path, host)
+
+        def req_dict(r: ScenarioRequest) -> dict:
+            return {"request_id": r.request_id, "spec": r.spec.to_dict(),
+                    "slot": r.slot, "status": r.status}
+
+        side = {
+            "serve_version": 1,
+            "slots": self.slots,
+            "chunk": self.chunk,
+            "chunks_polled": self.chunks_polled,
+            "active": {str(s): req_dict(r)
+                       for s, r in self._active.items()},
+            "pending": [req_dict(r) for r in self._pending],
+            "results": self.results,
+        }
+        with open(path + ".serve.json", "w") as f:
+            json.dump(side, f, indent=1)
+
+    @classmethod
+    def restore(cls, path: str, p: SimParams, mesh=None, engine=None,
+                out=None) -> "ResidentFleet":
+        """Resume a preempted service from :meth:`save`'s artifact pair."""
+        from ..sim import checkpoint as ckpt
+
+        with open(path + ".serve.json") as f:
+            side = json.load(f)
+        if side.get("serve_version") != 1:
+            raise ValueError(
+                f"{path}.serve.json: serve_version "
+                f"{side.get('serve_version')} != 1 (foreign artifact)")
+        svc = cls(p, slots=side["slots"], mesh=mesh, chunk=side["chunk"],
+                  engine=engine, out=out, fresh_state=False)
+        # Host-restore + device_put placement (NOT checkpoint.load_sharded's
+        # make_array_from_callback path): the resident executable is
+        # usually an AOT-store load, and on this toolchain a DESERIALIZED
+        # executable aborts the process when dispatched on
+        # callback-constructed arrays — device_put-placed inputs (exactly
+        # how a fresh fleet is placed) are the supported form.  A service
+        # state is one slots-sized fleet, so the host staging copy
+        # load_sharded exists to avoid is immaterial here.
+        like = jax.eval_shape(
+            lambda: svc.engine.init_batch(
+                svc.p, np.zeros(side["slots"], np.uint32)))
+        host = ckpt.load(path, svc.p, like=like)
+        # dedupe_buffers before placement, exactly like fresh init: a bare
+        # device_put of host numpy can ZERO-COPY alias the numpy memory on
+        # the CPU backend, and the chunk runner donates its input — XLA
+        # then recycles memory it doesn't own (observed: segfault on the
+        # second post-restore dispatch under the persistent compile
+        # cache).  The copy forces every leaf into an XLA-owned buffer.
+        svc._st = mesh_ops.shard_batch(
+            svc.mesh, sim_ops.dedupe_buffers(host))
+        svc.chunks_polled = int(side.get("chunks_polled", 0))
+        svc._dispatched = svc.chunks_polled
+        svc.results = dict(side.get("results", {}))
+        # Egressed requests re-register too (their spec rides the saved
+        # result): poll() keeps answering for them after a resume, and
+        # the submit()/auto-id duplicate guards see their ids — otherwise
+        # a post-resume submission could silently overwrite an old result.
+        for rid, res in svc.results.items():
+            req = ScenarioRequest(
+                rid, sc.ScenarioSpec.from_dict(res["spec"]),
+                slot=res.get("slot"), admitted_t=0.0, first_chunk_t=0.0,
+                egressed_t=0.0)
+            svc.requests[rid] = req
+        for s, rd in side.get("active", {}).items():
+            req = ScenarioRequest(
+                rd["request_id"], sc.ScenarioSpec.from_dict(rd["spec"]),
+                slot=int(s), admitted_t=0.0, first_chunk_t=0.0)
+            svc._active[int(s)] = req
+            svc.requests[req.request_id] = req
+        for rd in side.get("pending", []):
+            req = ScenarioRequest(
+                rd["request_id"], sc.ScenarioSpec.from_dict(rd["spec"]))
+            svc._pending.append(req)
+            svc.requests[req.request_id] = req
+        return svc
+
+    def close(self) -> None:
+        self._recorder.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -30,6 +30,30 @@ from ..core.types import SimParams
 from . import simulator as S
 
 
+#: The attack-schedule registry: every per-slot Byzantine schedule the
+#: scenario plane (serve/scenario.py) can select.  A scenario request names
+#: one of these plus a fault count f (or explicit authors); the selector is
+#: realized as the three per-instance [N] bool masks the engines already
+#: carry in state, so a heterogeneous fleet mixes schedules per slot with
+#: zero graph changes — the masks are traced data.
+SCHEDULES = ("honest", "equivocate", "silent", "forge_qc")
+
+
+def schedule_masks(p: SimParams, kind: str = "honest", f: int = 0,
+                   authors=None):
+    """(equivocate, silent, forge_qc) masks for a named attack schedule —
+    the scenario plane's Byzantine selector.  ``"honest"`` is all-clear
+    regardless of ``f``; the other kinds mark ``f`` authors (or the
+    explicit ``authors``) faulty via :func:`byz_masks`."""
+    if kind not in SCHEDULES:
+        raise ValueError(
+            f"unknown Byzantine schedule {kind!r}; want one of {SCHEDULES}")
+    if kind == "honest":
+        z = jnp.zeros((p.n_nodes,), jnp.bool_)
+        return z, z, z
+    return byz_masks(p, f, kind, authors)
+
+
 def byz_masks(p: SimParams, f: int, kind: str = "equivocate", authors=None):
     """(equivocate, silent, forge_qc) masks marking ``f`` authors as faulty
     (default: the first ``f``).

@@ -43,6 +43,21 @@ def _ho_default(field: str, leaf) -> np.ndarray:
     return np.full(leaf.shape, fill, leaf.dtype)
 
 
+def _sc_default(p: SimParams, field: str, leaf) -> np.ndarray:
+    """Knob-default scenario-plane rows for a pre-PR-11 (or
+    scenario-toggled) checkpoint: NOT soft state — the plane is consensus
+    config — but the correct restore for a checkpoint that predates it is
+    exactly the scenario the load params themselves describe (the same
+    restore rule the PR 4 watchdog used, except the default is the
+    params' values, not zeros).  A zero-width target (scenario off)
+    restores empty regardless of what was saved."""
+    if leaf.shape[-1] == 0:
+        return np.zeros(leaf.shape, leaf.dtype)
+    row = (np.asarray(p.delay_table(), leaf.dtype) if field == "sc_delay"
+           else np.asarray([p.commit_chain], leaf.dtype))
+    return np.broadcast_to(row, leaf.shape).copy()
+
+
 def save(path: str, state: SimState) -> None:
     arrays, _ = _flatten_with_paths(state)
     np.savez_compressed(path, **arrays)
@@ -103,6 +118,14 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
                 # checkpoints — same synthesis as the telemetry leaves.
                 leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
+            if field in ("sc_delay", "sc_commit"):
+                # Round 14's per-slot scenario plane: a pre-PR-11
+                # checkpoint restores with knob-DEFAULT rows derived from
+                # the load params (the scenario those params describe),
+                # so the resumed run is bit-identical to what the static
+                # engine would have done — see tests/test_checkpoint.py.
+                leaves.append(_sc_default(p, field, leaf))
+                continue
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]
         if arr.shape != leaf.shape:
@@ -121,6 +144,15 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
                 # telemetry/flight_cap/watchdog changed between save and
                 # resume: observability soft state — restart it empty.
                 leaves.append(np.zeros(leaf.shape, leaf.dtype))
+                continue
+            if field in ("sc_delay", "sc_commit"):
+                # SimParams.scenario toggled between save and resume:
+                # restore the knob-default rows of the LOAD params.  A
+                # scenario-on checkpoint loaded scenario-off keeps only
+                # what the static knobs express — the loud shape change
+                # is the operator's cue that per-slot scenarios were
+                # dropped.
+                leaves.append(_sc_default(p, field, leaf))
                 continue
             raise ValueError(f"{key}: shape {arr.shape} != {leaf.shape}")
         leaves.append(arr.astype(leaf.dtype))

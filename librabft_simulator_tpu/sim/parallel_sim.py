@@ -80,9 +80,12 @@ from ..core.types import (
     Pacemaker,
     SimParams,
     Store,
+    TracedParams,
     pack_payload,
     payload_width,
     sat_add,
+    sc_commit_init,
+    sc_delay_init,
     unpack_payload,
 )
 from ..telemetry import ledger as tledger
@@ -157,6 +160,10 @@ class PSimState:
     # Consensus watchdog plane (telemetry/stream.py); zero-width when
     # SimParams.watchdog is off.
     wd: jnp.ndarray
+    # Per-slot traced scenario plane (SimParams.scenario; serve/): both
+    # zero-width when off, read-only config when on — see SimState.
+    sc_delay: jnp.ndarray   # [T] int32 delay table row ([0] when off)
+    sc_commit: jnp.ndarray  # [1] int32 commit-chain selector ([0] when off)
 
 
 @struct.dataclass
@@ -197,6 +204,8 @@ class PackedPSimState:
     metrics: jnp.ndarray
     flight: jnp.ndarray
     wd: jnp.ndarray
+    sc_delay: jnp.ndarray
+    sc_commit: jnp.ndarray
 
 
 _PSIM_COMMON = packing._common_fields(PSimState)
@@ -218,7 +227,17 @@ def unpack_pstate(p: SimParams, pst: PackedPSimState) -> PSimState:
 
 
 def d_min_of(p: SimParams) -> int:
-    """Network lookahead: minimum message latency (>= 1)."""
+    """Network lookahead: minimum message latency (>= 1).
+
+    With the scenario plane on, slots carry their OWN delay tables (the
+    params' table is just the knob default), so the static value here is
+    only the conservative ARGUMENT default (1 — sound for any admitted
+    table); the step ignores it and derives each slot's true lookahead
+    in-graph from its ``sc_delay`` row (same formula), which is what
+    keeps per-slot window composition — and hence the whole trajectory,
+    inbox layout included — bit-identical to a dedicated static run."""
+    if p.scenario:
+        return 1
     return max(int(np.min(p.delay_table())), 1)
 
 
@@ -312,6 +331,8 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         metrics=tplane.init_plane(p),
         flight=tplane.init_flight(p),
         wd=tstream.init_wd(p),
+        sc_delay=sc_delay_init(p),
+        sc_commit=sc_commit_init(p),
     )
 
 
@@ -344,6 +365,20 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     A = lanes_of(p)
     K = drain_of(p)
     nc = 2 * n + 1
+    # Scenario plane (SimParams.scenario): per-slot delay table + traced
+    # commit-chain view — see sim/simulator.py.  The ``d_min`` lookahead
+    # is derived IN-GRAPH from the slot's OWN table (one fused min over
+    # the [T] row — exactly ``d_min_of``'s formula), not the caller's
+    # conservative scalar: window composition (horizon, drain batching,
+    # inbox routing order, the window-health telemetry) follows the
+    # lookahead, so only the slot's own value reproduces a dedicated
+    # static run of that scenario bit-for-bit, inbox layout included.
+    if p.scenario:
+        pp = TracedParams(p, st.sc_commit[0])
+        delay_table = st.sc_delay
+        d_min = jnp.maximum(jnp.min(st.sc_delay), 1)
+    else:
+        pp = p
 
     # ---- Window bookkeeping: per-node earliest times, global horizon.
     # The horizon must be GLOBAL (t_min + d_min), not per-node: with
@@ -423,24 +458,24 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
             a = sel[i]
             pay_in = unpack_payload(p, pay_row)
             s_n, should_sync = data_sync.handle_notification(
-                p, s_a, st.weights, pay_in)
+                pp, s_a, st.weights, pay_in)
             s_r, nx_r, cx_r = data_sync.handle_response(
-                p, s_a, nx_a, cx_a, st.weights, pay_in)
+                pp, s_a, nx_a, cx_a, st.weights, pay_in)
             s_in = store_ops._sel(is_notify[i], s_n,
                                   store_ops._sel(is_response[i], s_r, s_a))
             nx_in = store_ops._sel(is_response[i], nx_r, nx_a)
             cx_in = store_ops._sel(is_response[i], cx_r, cx_a)
             s_u, pm_u, nx_u, cx_u, actions = node_ops.update_node(
-                p, s_in, pm_a, nx_in, cx_in, st.weights, a, lc, dur_table)
+                pp, s_in, pm_a, nx_in, cx_in, st.weights, a, lc, dur_table)
             s_f = store_ops._sel(do_update[i], s_u, s_in)
             pm_f = store_ops._sel(do_update[i], pm_u, pm_a)
             nx_f = store_ops._sel(do_update[i], nx_u, nx_in)
             cx_f = store_ops._sel(do_update[i], cx_u, cx_in)
-            notif = data_sync.create_notification(p, s_f, a)
+            notif = data_sync.create_notification(pp, s_f, a)
             notif = store_ops._sel(lane_forge[i],
-                                   _forged_qc_payload(p, s_f, a, notif), notif)
-            request = data_sync.create_request(p, s_f)
-            response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
+                                   _forged_qc_payload(pp, s_f, a, notif), notif)
+            request = data_sync.create_request(pp, s_f)
+            response = data_sync.handle_request(pp, s_f, a, pay_in, notif=notif)
             resp_packed = pack_payload(response)
             if p.epoch_handoff:
                 # Cross-epoch handoff ring (mirrors sim/simulator.py):

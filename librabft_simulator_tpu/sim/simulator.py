@@ -43,8 +43,11 @@ from ..core.types import (
     SimParams,
     SimState,
     Store,
+    TracedParams,
     pack_payload,
     sat_add,
+    sc_commit_init,
+    sc_delay_init,
     unpack_payload,
 )
 from ..telemetry import ledger as tledger
@@ -128,6 +131,8 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         metrics=tplane.init_plane(p),
         flight=tplane.init_flight(p),
         wd=tstream.init_wd(p),
+        sc_delay=sc_delay_init(p),
+        sc_commit=sc_commit_init(p),
     )
 
 
@@ -207,6 +212,17 @@ def _forged_qc_payload(p: SimParams, s_a, author, pay: Payload) -> Payload:
 def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     """Process one event of one instance (loop_until body, simulator.rs:380-468)."""
     n, cm, k_chain = p.n_nodes, p.queue_cap, p.chain_k
+    # Scenario plane (SimParams.scenario; serve/scenario.py): the delay
+    # table and commit-chain selector come from the instance's OWN traced
+    # rows instead of the shared argument / static knob; ``pp`` is the
+    # params view the protocol code sees (types.TracedParams — only
+    # commit_chain is traced, everything else delegates).  Off (default):
+    # ``pp is p`` and the graph is the exact static-knob lowering.
+    if p.scenario:
+        pp = TracedParams(p, st.sc_commit[0])
+        delay_table = st.sc_delay
+    else:
+        pp = p
     with scope("event_select"):
         idx, t_min, is_timer = _select_event(p, st)
     halt = st.halted | (t_min > st.max_clock)
@@ -250,18 +266,18 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
             s_n, should_sync = jax.lax.cond(
                 is_notify,
                 lambda: data_sync.handle_notification(
-                    p, s_a, st.weights, pay_in),
+                    pp, s_a, st.weights, pay_in),
                 lambda: (s_a, jnp.bool_(False)))
             s_r, nx_r, cx_r = jax.lax.cond(
                 is_response,
                 lambda: data_sync.handle_response(
-                    p, s_a, nx_a, cx_a, st.weights, pay_in),
+                    pp, s_a, nx_a, cx_a, st.weights, pay_in),
                 lambda: (s_a, nx_a, cx_a))
         else:
             s_n, should_sync = data_sync.handle_notification(
-                p, s_a, st.weights, pay_in)
+                pp, s_a, st.weights, pay_in)
             s_r, nx_r, cx_r = data_sync.handle_response(
-                p, s_a, nx_a, cx_a, st.weights, pay_in)
+                pp, s_a, nx_a, cx_a, st.weights, pay_in)
         s_in = store_ops._sel(
             is_notify, s_n, store_ops._sel(is_response, s_r, s_a))
         nx_in = store_ops._sel(is_response, nx_r, nx_a)
@@ -269,7 +285,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
 
     with scope("node_update"):
         s_u, pm_u, nx_u, cx_u, actions = node_ops.update_node(
-            p, s_in, pm_a, nx_in, cx_in, st.weights, a, local_clock, dur_table
+            pp, s_in, pm_a, nx_in, cx_in, st.weights, a, local_clock, dur_table
         )
     s_f = store_ops._sel(do_update, s_u, s_in)
     pm_f = store_ops._sel(do_update, pm_u, pm_a)
@@ -277,12 +293,12 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     cx_f = store_ops._sel(do_update, cx_u, cx_in)
 
     # ---- Outgoing messages.
-    notif = data_sync.create_notification(p, s_f, a)
+    notif = data_sync.create_notification(pp, s_f, a)
     notif = store_ops._sel(st.byz_forge_qc[a],
-                           _forged_qc_payload(p, s_f, a, notif), notif)
-    notif_b = _equivocated_payload(p, s_f, a, notif)
-    request = data_sync.create_request(p, s_f)
-    response = data_sync.handle_request(p, s_f, a, pay_in, notif=notif)
+                           _forged_qc_payload(pp, s_f, a, notif), notif)
+    notif_b = _equivocated_payload(pp, s_f, a, notif)
+    request = data_sync.create_request(pp, s_f)
+    response = data_sync.handle_request(pp, s_f, a, pay_in, notif=notif)
     resp_packed = pack_payload(response)
     if p.epoch_handoff:
         # Cross-epoch handoff (reference keeps ALL previous epochs' stores:

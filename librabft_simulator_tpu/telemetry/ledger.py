@@ -83,6 +83,13 @@ DISPATCH = "dispatch"
 POLL = "poll"
 HOST_MERGE = "host_merge"
 RUN = "run"
+# Resident fleet service (serve/service.py): installing admitted scenario
+# rows into halted slots (one batched donated device write per admission
+# batch) and landing a finished slot's results on host.  Spans carry
+# request ids, so per-request latency (submit->admit->first-chunk->egress)
+# is reconstructible from the stream.
+ADMIT = "admit"
+EGRESS = "egress"
 
 #: A poll that returns faster than this means the chunk's digest was
 #: already sitting on host when the loop got to it: the device finished

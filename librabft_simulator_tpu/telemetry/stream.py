@@ -259,6 +259,15 @@ class TimelineRecorder:
             self._out.write(json.dumps(obj) + "\n")
             self._out.flush()
 
+    def emit(self, obj: dict) -> None:
+        """Append one extra NDJSON line to the stream (no-op without an
+        ``out``).  The resident fleet service (serve/service.py) rides its
+        request-lifecycle rows (``kind="request"``) on the digest stream
+        this way, so ``fleet_watch --serve`` follows one file.  Rows must
+        carry a ``kind`` other than meta/fleet/row — decoders dispatch on
+        it."""
+        self._emit(obj)
+
     def set_fleet(self, total: int, n_valid: int) -> None:
         """Fleet geometry from the runner (parallel/sharded.run_sharded):
         ``total`` is the PADDED instance count — what the digest's

@@ -34,6 +34,15 @@ toolchains.
   — on this toolchain the detectors cost 6 top-level fusion sites).
 * ``census_sharded`` 1160   — per-shard program 1081 (tpu_shape +
   scan/pack/halt-digest overhead) + headroom.
+* ``census_scenario`` 1140 — the per-slot scenario-plane graph
+  (SimParams.scenario; serve/scenario.py): tpu_shape_scenario 1068 vs
+  1047 off on the round-14 container (the same tree measures off at
+  1047, within the 1070 budget — residual toolchain jitter vs the
+  round-11 1000, not a graph change: the graph audit's off-graph
+  scenario arm proves zero sc-leaf eqns) — +21 fusion sites for the
+  traced per-slot delay-table reads and the 2-vs-3-chain commit
+  selects, ~7% headroom like the others.  Scenario OFF stays under
+  ``census_off`` exactly (zero-width leaves compile out).
 * ``census_k4`` 1090 / ``census_k16`` 1090 — the K-event macro-step
   programs (SimParams.macro_k; sim/simulator.py macro_step): 1018 top
   fusions at BOTH K=4 and K=16 — the rolled inner scan's body is one
@@ -60,6 +69,7 @@ BUDGETS = {
     "census_sharded": 1160,
     "census_k4": 1090,
     "census_k16": 1090,
+    "census_scenario": 1140,
     "tier1_min_dots": 39,
 }
 
@@ -71,6 +81,7 @@ SH_VARS = {
     "census_sharded": "SHARDED_CENSUS_BUDGET",
     "census_k4": "K4_CENSUS_BUDGET",
     "census_k16": "K16_CENSUS_BUDGET",
+    "census_scenario": "SCENARIO_CENSUS_BUDGET",
     "tier1_min_dots": "TIER1_MIN_DOTS",
 }
 

@@ -133,19 +133,25 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 parity_rc=$?
 
+echo "=== resident fleet service referees (tests/test_serve.py in FULL: heterogeneous-fleet parity, admission bit-identity, the one-digest-per-chunk resident poll pin) ==="
+timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_serve.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+serve_rc=$?
+
 echo "=== AOT store referees (tests/test_aot.py in FULL — the store-backed round trips are slow-marked out of the 870 s suite because their export fixture deliberately pays ~4 fresh compiles) ==="
 timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_aot.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 aot_rc=$?
 
-echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro) ==="
+echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro / ${SCENARIO_CENSUS_BUDGET} scenario) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-max "${CENSUS_BUDGET}" \
     --assert-telemetry-max "${TELEMETRY_CENSUS_BUDGET}" \
     --assert-watchdog-max "${WATCHDOG_CENSUS_BUDGET}" \
     --assert-sharded-max "${SHARDED_CENSUS_BUDGET}" \
     --assert-k4-max "${K4_CENSUS_BUDGET}" \
-    --assert-k16-max "${K16_CENSUS_BUDGET}"
+    --assert-k16-max "${K16_CENSUS_BUDGET}" \
+    --assert-scenario-max "${SCENARIO_CENSUS_BUDGET}"
 census_rc=$?
 
 tests_ok=0
@@ -164,6 +170,10 @@ if [ "$tests_ok" -ne 0 ]; then
 fi
 if [ "$parity_rc" -ne 0 ]; then
     echo "FAIL: fleet parity / stream / audit referees rc=$parity_rc" >&2
+    exit 1
+fi
+if [ "$serve_rc" -ne 0 ]; then
+    echo "FAIL: resident fleet service referees rc=$serve_rc" >&2
     exit 1
 fi
 if [ "$aot_rc" -ne 0 ]; then

@@ -1,0 +1,117 @@
+"""NDJSON front-end for the resident fleet service (serve/).
+
+Reads scenario requests from an NDJSON file (one JSON object per line —
+see serve/api.py for the schema), serves them on a resident fleet, and
+writes per-request results as NDJSON.  The live digest + request stream
+(``--stream`` / ``LIBRABFT_SERVE_OUT``) is followable from another
+terminal with ``scripts/fleet_watch.py --serve``.
+
+Usage:
+    python scripts/fleet_serve.py requests.ndjson
+    python scripts/fleet_serve.py requests.ndjson --out results.ndjson \\
+        --slots 8 --chunk 64 --dp 2 --stream /tmp/serve.ndjson
+    python scripts/fleet_serve.py requests.ndjson --nodes 4 --telemetry
+
+Service shape knobs (``--slots``/``--chunk`` default from
+``LIBRABFT_SERVE_SLOTS``/``LIBRABFT_SERVE_CHUNK``): the fleet's slot count
+and macro-chunk length are the residency geometry; per-request scenario
+knobs ride the requests themselves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("requests", help="NDJSON request file")
+    ap.add_argument("--out", default=None,
+                    help="results NDJSON path (default: stdout)")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="fleet slots (default LIBRABFT_SERVE_SLOTS or 8)")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="macro-steps per dispatched chunk "
+                         "(default LIBRABFT_SERVE_CHUNK or 64)")
+    ap.add_argument("--dp", type=int, default=1,
+                    help="dp mesh width (devices; default 1)")
+    ap.add_argument("--nodes", type=int, default=4,
+                    help="committee size every scenario shares (structural)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="arm the in-graph telemetry plane (per-request "
+                         "metrics ride the egress results)")
+    ap.add_argument("--watchdog", action="store_true",
+                    help="arm the in-graph consensus watchdog (trip counts "
+                         "ride the streamed digests)")
+    ap.add_argument("--stream", default=None,
+                    help="live digest+request NDJSON stream path "
+                         "(default LIBRABFT_SERVE_OUT; follow with "
+                         "fleet_watch --serve)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="preempt after draining: checkpoint the resident "
+                         "state here (resume with FleetService.resume)")
+    ap.add_argument("--max-chunks", type=int, default=None,
+                    help="chunk ceiling for the serve loop")
+    args = ap.parse_args(argv)
+
+    if args.dp > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(8, args.dp)}").strip()
+
+    import jax
+
+    from librabft_simulator_tpu.core.types import SimParams
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+    from librabft_simulator_tpu.serve import FleetService, load_requests
+    from librabft_simulator_tpu.utils.cache import setup_compile_cache
+
+    setup_compile_cache()
+
+    try:
+        requests = load_requests(args.requests)
+    except (OSError, ValueError) as e:
+        print(f"fleet_serve: {e}", file=sys.stderr)
+        return 1
+
+    p = SimParams(n_nodes=args.nodes, telemetry=args.telemetry,
+                  watchdog=args.watchdog)
+    mesh = (mesh_ops.make_mesh(n_dp=args.dp, n_mp=1,
+                               devices=jax.devices()[:args.dp])
+            if args.dp > 1 else None)
+    out_f = open(args.out, "w") if args.out else sys.stdout
+    try:
+        with FleetService(p, slots=args.slots, chunk=args.chunk, mesh=mesh,
+                          out=args.stream) as svc:
+            for rid, spec in requests:
+                svc.submit(spec, request_id=rid)
+            kw = ({} if args.max_chunks is None
+                  else {"max_chunks": args.max_chunks})
+            results = svc.drain(**kw)
+            for rid, _ in requests:  # submission order, not egress order
+                out_f.write(json.dumps(results[rid]) + "\n")
+            occ = svc.fleet.occupancy()
+            print(f"# served {len(results)} requests on {occ['slots']} "
+                  f"slots, {svc.fleet.chunks_polled} chunks",
+                  file=sys.stderr)
+            if args.checkpoint:
+                svc.preempt(args.checkpoint)
+                print(f"# resident state checkpointed to "
+                      f"{args.checkpoint} (+.serve.json)", file=sys.stderr)
+    finally:
+        if args.out:
+            out_f.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

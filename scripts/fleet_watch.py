@@ -10,6 +10,7 @@ that stream observable from ANOTHER terminal while the run is still going:
     python scripts/fleet_watch.py /tmp/fleet.ndjson --once     # print + exit
     python scripts/fleet_watch.py /tmp/fleet.ndjson --summary  # final digest
     python scripts/fleet_watch.py /tmp/ledger.ndjson --ledger  # host ledger
+    python scripts/fleet_watch.py /tmp/serve.ndjson --serve    # admission view
 
 One line per polled chunk: halt progress (padding-corrected when the
 runner emitted a fleet meta line), events/s, commit/drop/overflow counts,
@@ -20,6 +21,13 @@ anomaly, safety violation) trips.  Reads are registry-version-checked
 a stale viewer can never silently misread a newer stream.  Partially
 written files are fine: a mid-write trailing line is skipped, and an
 empty/meta-less file exits with a clear message instead of a traceback.
+
+``--serve`` reads a resident-fleet SERVICE stream (serve/service.py,
+``LIBRABFT_SERVE_OUT`` / ``FleetService(out=...)``): the admission-queue
+view — pending/admitted/egressed counts, slot occupancy, and per-request
+ttfc (admission → first polled chunk) as requests flow through, plus the
+digest heartbeat.  Same hardening as every other mode: an empty, foreign,
+or meta-less file exits 1 with a message, never a traceback.
 
 ``--ledger`` reads a RUNTIME-LEDGER stream instead (telemetry/ledger.py,
 ``LIBRABFT_LEDGER_OUT``): per-chunk dispatch-enqueue vs blocking-poll
@@ -194,6 +202,92 @@ def show_ledger(path: str, out=None) -> int:
     return 0
 
 
+class _ServeView:
+    """The --serve formatter: request-lifecycle rows as an event log,
+    digest rows as a compact occupancy heartbeat."""
+
+    def __init__(self, out=sys.stdout):
+        self.out = out
+        self.slots = None
+        self.last: dict = {}
+        self.header_done = False
+
+    def _header(self):
+        print(f"{'t_s':>8} {'event':>11} {'request':>10} {'slot':>5} "
+              f"{'ttfc_s':>8} {'pend':>5} {'actv':>5} {'done':>5}  detail",
+              file=self.out)
+        self.header_done = True
+
+    def feed(self, obj: dict) -> None:
+        kind = obj.get("kind")
+        if kind == "meta":
+            treport.require_registry_version(obj.get("registry_version"),
+                                             what="serve stream")
+            if not obj.get("serve"):
+                raise ValueError(
+                    "not a serve stream (no serve marker in the meta "
+                    "line); plain digest streams want the default view")
+            self.slots = obj.get("slots")
+            print(f"# resident fleet: {self.slots} slots x "
+                  f"chunk {obj.get('chunk')} (n_nodes={obj.get('n_nodes')},"
+                  f" registry v{obj.get('registry_version')})",
+                  file=self.out)
+            return
+        if kind == "request":
+            if not self.header_done:
+                self._header()
+            self.last = obj
+            ttfc = obj.get("ttfc_s")
+            detail = ""
+            if obj.get("event") == "egressed":
+                res = obj.get("result") or {}
+                detail = (f"events={res.get('events')} "
+                          f"commits={res.get('commits')} "
+                          f"safe={res.get('safe')} "
+                          f"latency_s={obj.get('latency_s')}")
+            print(f"{obj.get('t_s', 0):>8.2f} {obj.get('event', '?'):>11} "
+                  f"{str(obj.get('id')):>10} "
+                  f"{str(obj.get('slot', '-')):>5} "
+                  f"{ttfc if ttfc is not None else '-':>8} "
+                  f"{obj.get('pending', 0):>5} {obj.get('active', 0):>5} "
+                  f"{obj.get('egressed', 0):>5}  {detail}",
+                  file=self.out, flush=True)
+            return
+        if kind == "row":
+            if not self.header_done:
+                self._header()
+            occ = (f"occupancy {self.last.get('active', '?')}/{self.slots}"
+                   if self.slots else "")
+            print(f"{obj.get('t_s', 0):>8.2f} {'chunk':>11} "
+                  f"{'':>10} {'':>5} {'':>8} "
+                  f"{self.last.get('pending', 0):>5} "
+                  f"{self.last.get('active', 0):>5} "
+                  f"{self.last.get('egressed', 0):>5}  "
+                  f"halted={obj.get('halted')} events={obj.get('events')} "
+                  f"{occ}", file=self.out, flush=True)
+
+
+def show_serve(path: str, out=None) -> int:
+    """The --serve one-shot view (exit 1 on empty/foreign files)."""
+    out = out if out is not None else sys.stdout
+    meta, rows = tstream.load_ndjson(path)
+    view = _ServeView(out=out)
+    view.feed(dict(meta, kind="meta"))
+    events = [r for r in rows if r.get("kind") == "request"]
+    if not events:
+        print("no request rows yet", file=sys.stderr)
+        return 1
+    for r in rows:
+        if r.get("kind") == "request":
+            view.feed(r)
+    # Closing occupancy summary from the newest row.
+    last = events[-1]
+    print(f"# pending={last.get('pending')} active={last.get('active')} "
+          f"egressed={last.get('egressed')} of {meta.get('slots')} slots",
+          file=out)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("path", help="NDJSON stream file (TimelineRecorder out=)")
@@ -206,6 +300,13 @@ def main(argv=None) -> int:
                          "(LIBRABFT_LEDGER_OUT): print per-chunk "
                          "dispatch/poll timing, overlap, bubbles, and "
                          "the compile ledger")
+    ap.add_argument("--serve", action="store_true",
+                    help="the file is a resident-fleet service stream "
+                         "(serve/; LIBRABFT_SERVE_OUT): print the "
+                         "admission-queue event log — pending/admitted/"
+                         "egressed counts, slot occupancy, per-request "
+                         "ttfc — plus the digest heartbeat; --once/"
+                         "default follow both work")
     ap.add_argument("--poll", type=float, default=0.5,
                     help="follow-mode poll interval in seconds")
     ap.add_argument("--idle-timeout", type=float, default=None,
@@ -215,6 +316,14 @@ def main(argv=None) -> int:
     try:
         if args.ledger:
             return show_ledger(args.path)
+
+        if args.serve:
+            if args.once or args.summary:
+                return show_serve(args.path)
+            view = _ServeView()
+            follow(args.path, view, poll_s=args.poll,
+                   idle_timeout_s=args.idle_timeout)
+            return 0
 
         if args.summary:
             meta, rows = tstream.load_ndjson(args.path)

@@ -21,9 +21,20 @@ message counters, per-node committed chains, store heads, and lock rounds.
 Usage: python scripts/fuzz_parity.py [minutes]   # default 30
     FUZZ_PACKED=1 python scripts/fuzz_parity.py 10   # packed-plane engine
     FUZZ_MACRO_K=1 python scripts/fuzz_parity.py 10  # randomize macro_k
+    FUZZ_SCENARIO=1 python scripts/fuzz_parity.py 10 # heterogeneous fleets
 Writes FUZZ_PARITY_r05.json (FUZZ_PARITY_r06_packed.json under
-FUZZ_PACKED=1; FUZZ_PARITY_r11_macro.json under FUZZ_MACRO_K=1)
+FUZZ_PACKED=1; FUZZ_PARITY_r11_macro.json under FUZZ_MACRO_K=1;
+FUZZ_PARITY_r14_scenario.json under FUZZ_SCENARIO=1)
 {trials, structural_shapes, macro_trials, failures[]}.
+
+FUZZ_SCENARIO=1 is the serving-regime campaign: every trial builds a
+small fleet whose slots each draw an INDEPENDENT random scenario row
+(delay distribution, drop rate, horizon, 2-vs-3 commit chain, Byzantine
+schedule, rng seed — serve/scenario.py), runs the whole batch on ONE
+scenario-armed executable, and pins every slot against its own dedicated
+oracle — the heterogeneous-fleet parity claim of the resident fleet
+service, fuzzed.  Minidumps record the full plane (per-slot spec dicts),
+which replays the trial exactly.
 """
 
 from __future__ import annotations
@@ -86,6 +97,18 @@ PACKED = xops._bool_env("FUZZ_PACKED") or False
 MACRO = xops._bool_env("FUZZ_MACRO_K") or False
 MACRO_KS = (1, 2, 4, 8)
 
+# FUZZ_SCENARIO=1: heterogeneous-fleet trials on the per-slot scenario
+# plane (see module docstring).  The structural axis shrinks to SHAPES
+# only — commit_chain and the whole delay family are per-slot data now,
+# which is exactly the executable-count collapse being fuzzed.
+SCENARIO = xops._bool_env("FUZZ_SCENARIO") or False
+SCENARIO_SLOTS = 4
+SCENARIO_STRUCTURAL = [
+    dict(n_nodes=3),
+    dict(n_nodes=4),
+    dict(n_nodes=5, window=8, chain_k=2, commit_log=16),
+]
+
 DELAYS = [
     dict(delay_kind="lognormal", delay_mean=10.0, delay_variance=4.0),
     dict(delay_kind="lognormal", delay_mean=25.0, delay_variance=16.0),
@@ -102,11 +125,10 @@ def committed_chain(st, node, H):
             for i in range(max(cc - H, 0), cc)]
 
 
-def one_trial(p: SimParams, seed: int, byz=None) -> list[str]:
-    kw = dict(byz or {})
-    st = S.init_state(p, seed, **{k: np.asarray(v) for k, v in kw.items()})
-    st = S.run_to_completion(p, st)
-    orc = OracleSim(p, seed, **{k: list(v) for k, v in kw.items()}).run()
+def compare_oracle(p: SimParams, st, orc, byz_any) -> list[str]:
+    """The full test_parity invariant set between an (unbatched, host)
+    engine state and a finished oracle — shared by the static trials and
+    the per-slot checks of the FUZZ_SCENARIO heterogeneous-fleet mode."""
     errs = []
     for name, a, b in [
         ("n_events", int(st.n_events), orc.n_events),
@@ -129,13 +151,62 @@ def one_trial(p: SimParams, seed: int, byz=None) -> list[str]:
     # Safety invariant: across honest nodes, one tag per committed depth
     # (holds for any f <= floor((n-1)/3) attacker mix the sampler draws).
     # Reuses the suite's reference checker on a batch-of-1 view.
-    byz_any = np.zeros(p.n_nodes, bool)
-    for v in (byz or {}).values():
-        byz_any |= np.asarray(v, bool)
     st1 = jax.tree.map(lambda x: np.asarray(x)[None], st)
     if not byzantine.check_safety_reference(st1, honest_mask=~byz_any)[0]:
         errs.append("SAFETY: honest nodes committed conflicting tags")
     return errs
+
+
+def one_trial(p: SimParams, seed: int, byz=None) -> list[str]:
+    kw = dict(byz or {})
+    st = S.init_state(p, seed, **{k: np.asarray(v) for k, v in kw.items()})
+    st = S.run_to_completion(p, st)
+    orc = OracleSim(p, seed, **{k: list(v) for k, v in kw.items()}).run()
+    byz_any = np.zeros(p.n_nodes, bool)
+    for v in (byz or {}).values():
+        byz_any |= np.asarray(v, bool)
+    return compare_oracle(p, st, orc, byz_any)
+
+
+def scenario_trial(base_kw: dict, rng) -> tuple[list, dict]:
+    """One heterogeneous-fleet trial: SCENARIO_SLOTS independent random
+    scenario rows on ONE scenario-armed executable, each slot pinned
+    against its own dedicated oracle.  Returns (specs, {slot: errors})."""
+    from librabft_simulator_tpu.serve import scenario as sc
+
+    base = SimParams(**base_kw, packed=PACKED)
+    p_sc = dataclasses.replace(base, scenario=True)
+    specs = []
+    for _ in range(SCENARIO_SLOTS):
+        runtime = dict(rng.choice(DELAYS))
+        n = base.n_nodes
+        f_max = (n - 1) // 3
+        kind, f = "honest", 0
+        if f_max and rng.random() < 0.4:
+            kind = rng.choice(["equivocate", "silent", "forge_qc"])
+            f = rng.randrange(1, f_max + 1)
+        specs.append(sc.ScenarioSpec(
+            **runtime,
+            drop_prob=rng.choice([0.0, 0.0, 0.02, 0.05, 0.15]),
+            max_clock=rng.choice([400, 800, 1500]),
+            commit_chain=rng.choice([2, 3]),
+            byz_kind=kind, byz_f=f,
+            seed=rng.randrange(2**31)))
+    st = sc.init_specs(p_sc, specs)
+    st = S.run_to_completion(p_sc, st, batched=True)
+    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), st)
+    slot_errs = {}
+    for i, spec in enumerate(specs):
+        p_i = spec.to_params(base)
+        eq, silent, forge = (np.asarray(m) for m in spec.byz_masks(base))
+        orc = OracleSim(p_i, spec.seed,
+                        byz_equivocate=list(eq), byz_silent=list(silent),
+                        byz_forge_qc=list(forge)).run()
+        st_i = jax.tree.map(lambda x, ii=i: x[ii], host)
+        errs = compare_oracle(p_i, st_i, orc, eq | silent | forge)
+        if errs:
+            slot_errs[i] = errs
+    return specs, slot_errs
 
 
 def write_minidump(p: SimParams, seed: int, structural: dict, runtime: dict,
@@ -190,6 +261,39 @@ def main() -> int:
     shapes_used = set()
     failures = []
     while time.time() < deadline:
+        if SCENARIO:
+            # Heterogeneous-fleet mode: the structural axis is SHAPES
+            # only (delay/commit-chain/byz/drop are per-slot data — the
+            # executable-count collapse under test); every trial fuzzes
+            # SCENARIO_SLOTS independent scenarios at once.
+            sk = rng.randrange(len(SCENARIO_STRUCTURAL))
+            structural = SCENARIO_STRUCTURAL[sk]
+            specs, slot_errs = scenario_trial(structural, rng)
+            trials += 1
+            shapes_used.add((sk, 1))
+            for spec in specs:
+                if spec.byz_kind != "honest":
+                    byz_trials["byz_" + spec.byz_kind] += 1
+            if slot_errs:
+                plane = [s.to_dict() for s in specs]
+                dump = dict(structural=structural, plane=plane,
+                            slot_errors={str(k): v
+                                         for k, v in slot_errs.items()})
+                path = (f"FUZZ_MINIDUMP_SCEN_{len(failures):04d}_"
+                        f"seed{specs[0].seed}.json")
+                with open(path, "w") as f:
+                    json.dump(dump, f, indent=1, default=str)
+                failures.append(dict(structural=structural, plane=plane,
+                                     errors=[e for v in slot_errs.values()
+                                             for e in v],
+                                     minidump=path))
+                print(json.dumps(failures[-1]), flush=True)
+            if trials % 10 == 0:
+                print(f"[fuzz] {trials} scenario trials "
+                      f"({trials * SCENARIO_SLOTS} slots), "
+                      f"{len(failures)} failures", file=sys.stderr,
+                      flush=True)
+            continue
         sk = rng.randrange(len(STRUCTURAL))
         structural = STRUCTURAL[sk]
         runtime = dict(rng.choice(DELAYS))
@@ -227,11 +331,14 @@ def main() -> int:
             print(f"[fuzz] {trials} trials, {len(shapes_used)} shapes, "
                   f"{len(failures)} failures", file=sys.stderr, flush=True)
     out = dict(trials=trials, byz_trials=byz_trials, packed=PACKED,
-               macro=MACRO,
+               macro=MACRO, scenario=SCENARIO,
+               scenario_slots=(SCENARIO_SLOTS if SCENARIO else 0),
+               slots_checked=(trials * SCENARIO_SLOTS if SCENARIO else 0),
                macro_trials={str(k): v for k, v in
                              sorted(macro_trials.items())},
                structural_shapes=len(shapes_used), failures=failures)
-    artifact = ("FUZZ_PARITY_r11_macro.json" if MACRO
+    artifact = ("FUZZ_PARITY_r14_scenario.json" if SCENARIO
+                else "FUZZ_PARITY_r11_macro.json" if MACRO
                 else "FUZZ_PARITY_r06_packed.json" if PACKED
                 else "FUZZ_PARITY_r05.json")
     with open(artifact, "w") as f:

@@ -3,10 +3,11 @@
 Passes (librabft_simulator_tpu/audit/):
 
 1. **Graph lint** — traces both engines' step functions in every lowering
-   flavor (cpu_default, tpu_shape, telemetry/watchdog twins, the serial
-   engine's K-macro rungs tpu_shape_k{4,16} plus the macro_k=1-identity
-   pin, the dp-sharded runner) and enforces jaxpr rules R1-R6
-   (graph_lint.py).
+   flavor (cpu_default, tpu_shape, telemetry/watchdog twins, the
+   scenario-plane flavor tpu_shape_scenario plus its off-inert /
+   read-only-pass-through R6 arm, the serial engine's K-macro rungs
+   tpu_shape_k{4,16} plus the macro_k=1-identity pin, the dp-sharded
+   runner) and enforces jaxpr rules R1-R6 (graph_lint.py).
    Tracing never compiles, so the whole matrix costs ~2 minutes, vs the
    census's XLA compiles — which is why CI runs this FIRST.
 2. **Source lint** — AST rules S1-S4 (host libs in traced code,

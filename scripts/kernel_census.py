@@ -227,6 +227,15 @@ MODES = {
                          gate_handlers=True, macro_k=4),
     "tpu_shape_k16": dict(packed=True, dense_writes="dense",
                           gate_handlers=True, macro_k=16),
+    # Per-slot scenario plane (SimParams.scenario; serve/scenario.py):
+    # the delay table becomes a traced per-slot [T] row and the commit
+    # rule a traced 2-vs-3-chain select, so ONE executable serves a
+    # heterogeneous scenario fleet.  Scenario OFF must leave tpu_shape
+    # untouched (the --assert-max gate — zero-width leaves compile out);
+    # ON pays its own budget (--assert-scenario-max) — the per-slot
+    # selects' fusion cost is gated here, not guessed.
+    "tpu_shape_scenario": dict(packed=True, dense_writes="dense",
+                               gate_handlers=True, scenario=True),
 }
 
 
@@ -255,6 +264,11 @@ def main() -> int:
     ap.add_argument("--assert-k16-max", type=int, default=None,
                     help="exit nonzero if the tpu_shape_k16 macro-step "
                          "fusion count exceeds this budget (CI gate)")
+    ap.add_argument("--assert-scenario-max", type=int, default=None,
+                    help="exit nonzero if the tpu_shape_scenario fusion "
+                         "count exceeds this budget (CI gate; the "
+                         "scenario-plane per-slot select graph — "
+                         "scenario OFF is covered by --assert-max)")
     ap.add_argument("--sharded", action="store_true",
                     help="also census the per-shard dp-fleet program "
                          "(shard_map runner on a 2-shard virtual CPU mesh)")
@@ -289,6 +303,8 @@ def main() -> int:
             args.assert_k4_max = b["census_k4"]
         if args.assert_k16_max is None:
             args.assert_k16_max = b["census_k16"]
+        if args.assert_scenario_max is None:
+            args.assert_scenario_max = b["census_scenario"]
     if args.assert_sharded_max is not None:
         args.sharded = True
 
@@ -369,10 +385,11 @@ def main() -> int:
               f"exceeds budget {args.assert_watchdog_max}", file=sys.stderr)
         return 1
     for kname, budget in (("tpu_shape_k4", args.assert_k4_max),
-                          ("tpu_shape_k16", args.assert_k16_max)):
+                          ("tpu_shape_k16", args.assert_k16_max),
+                          ("tpu_shape_scenario", args.assert_scenario_max)):
         kc = out["modes"][kname]["top_fusions"]
         if budget is not None and kc > budget:
-            print(f"FAIL: {kname} macro-step fusion count {kc} exceeds "
+            print(f"FAIL: {kname} fusion count {kc} exceeds "
                   f"budget {budget}", file=sys.stderr)
             return 1
     if args.assert_sharded_max is not None:

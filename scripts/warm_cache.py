@@ -71,7 +71,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", "tests"))
 from fleet_shapes import (  # noqa: E402
     FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_MACRO_SER_KW,
-    FLEET_MACRO_WD_SER_KW, FLEET_SER_KW, FLEET_WD_LANE_KW, FLEET_WD_SER_KW)
+    FLEET_MACRO_WD_SER_KW, FLEET_SCENARIO_LANE_KW, FLEET_SCENARIO_SER_KW,
+    FLEET_SER_KW, FLEET_WD_LANE_KW, FLEET_WD_SER_KW, SERVE_CHUNK, SERVE_DP,
+    SERVE_SLOTS)
 
 # Unsharded reference runs of the tier-1 2-shard parity pair, plus the
 # watchdog-armed twins tests/test_stream.py runs (watchdog and its stall
@@ -94,6 +96,15 @@ SHAPES += [
     # (its digest flavor compiles via the watchdog branch below).
     ("serial", FLEET_MACRO_SER_KW, FLEET_B, FLEET_CHUNK),
     ("serial", FLEET_MACRO_WD_SER_KW, FLEET_B, FLEET_CHUNK),
+    # Resident-service scenario twins (serve/; tests/test_serve.py): the
+    # per-slot scenario plane is a compile key, but the LAST one its
+    # family needs — ONE serial entry covers every delay kind, drop rate,
+    # Byzantine schedule, and 2-vs-3 commit chain the heterogeneous-fleet
+    # referees mix (and the dedicated static chain-3 references of those
+    # referees are the FLEET_SER_KW entries above).  The lane twin covers
+    # the lane-engine scenario parity leg.
+    ("serial", FLEET_SCENARIO_SER_KW, SERVE_SLOTS, SERVE_CHUNK),
+    ("parallel", FLEET_SCENARIO_LANE_KW, SERVE_SLOTS, SERVE_CHUNK),
 ]
 
 # Sanitizer (audit/sanitize.py) twins of the micro fleet pair: the
@@ -120,6 +131,11 @@ SHARDED_SHAPES = [
     # The macro-armed sharded twin: test_stream.py pins the per-chunk
     # digest's true event accounting at K>1 through run_sharded.
     ("serial", FLEET_MACRO_WD_SER_KW, FLEET_B, FLEET_CHUNK, 2),
+    # THE resident fleet service executable (serve/service.py builds the
+    # identical make_sharded_run_fn key: scenario-armed structural params
+    # + mesh + chunk): one entry serves every scenario config a serve
+    # session admits — the executable-count collapse in one line.
+    ("serial", FLEET_SCENARIO_SER_KW, SERVE_SLOTS, SERVE_CHUNK, SERVE_DP),
 ]
 
 #: Shared child preamble: pin the CPU backend BEFORE the jax import and

@@ -44,3 +44,18 @@ FLEET_WD_LANE_KW = dict(FLEET_LANE_KW, watchdog=True,
 FLEET_MACRO_K = 4
 FLEET_MACRO_SER_KW = dict(FLEET_SER_KW, macro_k=FLEET_MACRO_K)
 FLEET_MACRO_WD_SER_KW = dict(FLEET_WD_SER_KW, macro_k=FLEET_MACRO_K)
+
+# Resident fleet service twins (serve/; tests/test_serve.py): the micro
+# shapes with the per-slot scenario plane armed.  ``scenario`` is a
+# compile key (the sc_* leaves change the argument signature and the
+# commit rule becomes a traced select), but it is the LAST fork this
+# family needs: one scenario executable serves every delay kind, drop
+# rate, Byzantine schedule, and 2-vs-3 commit chain the suite mixes —
+# which is exactly the AOT-store collapse the serve PR exists for.  The
+# service's resident chunk runs sharded (SERVE_DP) at SERVE_CHUNK
+# macro-steps per dispatch; test_serve and warm_cache both read these.
+FLEET_SCENARIO_SER_KW = dict(FLEET_SER_KW, scenario=True)
+FLEET_SCENARIO_LANE_KW = dict(FLEET_LANE_KW, scenario=True)
+SERVE_SLOTS = 4
+SERVE_CHUNK = 32
+SERVE_DP = 2

@@ -153,6 +153,14 @@ class TestEnginesClean:
             assert ks["writes"]["scalar"] == 0
             assert ks["writes"]["vector_waived"] == 1
             assert ks["float_eqns"] == 0
+        # The scenario-plane flavor (per-slot traced delay table +
+        # commit-chain select, serve/scenario.py) adds NO write sites —
+        # the plane is read-only config (the R6 scenario arm held above:
+        # off-graph sc-leaf-inert + on-graph identity pass-through).
+        sc = stats["serial/tpu_shape_scenario"]
+        assert sc["writes"]["scalar"] == 0
+        assert sc["writes"]["vector_waived"] == 1
+        assert sc["float_eqns"] == 0
 
     def test_lane_clean(self):
         # R6 (the DCE pass) for the lane engine runs in the CI census-
@@ -291,9 +299,13 @@ class TestBudgetsAndKnobs:
         ns = SL._load_budgets(REPO)
         assert set(ns) == {"census_off", "census_telemetry",
                            "census_watchdog", "census_sharded",
-                           "census_k4", "census_k16",
+                           "census_k4", "census_k16", "census_scenario",
                            "tier1_min_dots"}
         assert ns["census_telemetry"] > ns["census_off"]
+        # The scenario plane's per-slot selects cost a bounded premium
+        # over the off graph (serve/scenario.py; +21 measured round 14).
+        assert ns["census_off"] < ns["census_scenario"] \
+            <= ns["census_off"] + 100
         # The macro rungs' dispatched program stays ~flat in K (the
         # rolled inner scan's body is one step): the K=16 budget may not
         # silently balloon past K=4 — fusions-per-event amortization is

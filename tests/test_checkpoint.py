@@ -134,6 +134,57 @@ def test_load_checkpoint_missing_new_fields(tmp_path):
                                   np.asarray(st.ctx.commit_count))
 
 
+def test_scenario_plane_restore_pre_pr11(tmp_path):
+    """A pre-PR-11 checkpoint (no sc_* leaves) restores into a
+    scenario-armed config with knob-DEFAULT plane rows — the scenario the
+    load params themselves describe — and the resumed run continues
+    bit-identically to an uninterrupted scenario run carrying those same
+    default rows (the PR 4 watchdog-restore pattern, except the default
+    is the params' values, not zeros)."""
+    import dataclasses
+
+    from fleet_shapes import FLEET_SCENARIO_SER_KW, SERVE_CHUNK, SERVE_SLOTS
+
+    p = SimParams(max_clock=2**30, **FLEET_SCENARIO_SER_KW)
+    run = S.make_run_fn(p, SERVE_CHUNK, batched=True)
+    seeds = np.arange(SERVE_SLOTS, dtype=np.uint32)
+    full = run(S.dedupe_buffers(S.init_batch(p, seeds)))
+    full = run(full)
+
+    half = run(S.dedupe_buffers(S.init_batch(p, seeds)))
+    f = str(tmp_path / "pre11.npz")
+    C.save(f, half)
+    # Simulate the pre-PR-11 artifact: strip the scenario leaves.
+    data = dict(np.load(f))
+    stripped = {k: v for k, v in data.items() if not k.startswith("sc_")}
+    assert len(stripped) == len(data) - 2
+    np.savez_compressed(f, **stripped)
+    st2 = C.load(f, p, like=S.init_batch(p, np.zeros(SERVE_SLOTS,
+                                                     np.uint32)))
+    # Knob-default rows synthesized from the load params.
+    np.testing.assert_array_equal(
+        np.asarray(st2.sc_delay),
+        np.broadcast_to(p.delay_table(), (SERVE_SLOTS,) +
+                        p.delay_table().shape))
+    np.testing.assert_array_equal(
+        np.asarray(st2.sc_commit),
+        np.full((SERVE_SLOTS, 1), p.commit_chain, np.int32))
+    # Round-trip regression: the resumed run continues bit-identically.
+    st2 = run(S.dedupe_buffers(st2))
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(st2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # A scenario-on checkpoint loaded scenario-OFF drops the plane loudly
+    # into the static knobs (zero-width leaves) and still restores the
+    # protocol state exactly.
+    p_off = dataclasses.replace(p, scenario=False)
+    off = C.load(f, p_off, like=S.init_batch(p_off,
+                                             np.zeros(SERVE_SLOTS,
+                                                      np.uint32)))
+    assert np.asarray(off.sc_delay).shape == (SERVE_SLOTS, 0)
+    np.testing.assert_array_equal(np.asarray(off.clock),
+                                  np.asarray(half.clock))
+
+
 def test_macro_step_boundary_roundtrip(tmp_path):
     """K-event macro-steps (SimParams.macro_k) across a checkpoint: a K=4
     run checkpointed mid-run restores and CONTINUES UNDER K=1

@@ -1,0 +1,375 @@
+"""Resident fleet service referees (serve/: scenario plane + admission).
+
+The three serving-semantics pins of PR 14:
+
+(a) **Heterogeneous-fleet parity** — mixed delay kinds, mixed 2-/3-chain
+    commit rules, and mixed Byzantine schedules in ONE scenario-armed
+    batch are bit-identical PER SLOT to dedicated static batch-mode runs
+    of each scenario, and match the oracle's counters/chains.
+(b) **Admission isolation** — installing a new scenario into a halted
+    slot mid-run leaves every live slot's trajectory bit-identical to an
+    undisturbed run (halted slots are observably inert; the admission
+    write is a pure masked select).
+(c) **Resident poll contract** — the never-exiting service loop still
+    fetches exactly one [13] digest per dispatched chunk (the
+    monkeypatched-device_get proof, serving edition), and a serve session
+    spanning >= 3 distinct scenario configs records exactly ONE sharded
+    fleet-chunk compile entry — no per-scenario recompiles.
+
+Engine-running tests are slow-marked (each pays micro-shape compiles on a
+cold cache); scripts/ci_tier1.sh runs this module IN FULL as an explicit
+referee leg, like tests/test_aot.py.  Shapes ride tests/fleet_shapes.py
+so scripts/warm_cache.py pre-pays the heavy ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.oracle.sim import OracleSim
+from librabft_simulator_tpu.parallel import mesh as mesh_ops
+from librabft_simulator_tpu.parallel import sharded
+from librabft_simulator_tpu.serve import scenario as sc
+from librabft_simulator_tpu.serve import api as serve_api
+from librabft_simulator_tpu.serve.service import ResidentFleet
+from librabft_simulator_tpu.sim import parallel_sim as PS
+from librabft_simulator_tpu.sim import simulator as S
+from librabft_simulator_tpu.telemetry import ledger as tledger
+from librabft_simulator_tpu.telemetry import stream as tstream
+
+from fleet_shapes import (FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW,
+                          SERVE_CHUNK, SERVE_DP, SERVE_SLOTS)
+
+MAX_CLOCK = 300
+P_BASE = SimParams(max_clock=MAX_CLOCK, **FLEET_SER_KW)
+P_SC = dataclasses.replace(P_BASE, scenario=True)
+
+#: The heterogeneous referee fleet: mixed delay kinds, mixed 2-/3-chain,
+#: mixed Byzantine schedules — one scenario per slot, SERVE_SLOTS wide.
+SPECS = [
+    sc.ScenarioSpec(max_clock=MAX_CLOCK, seed=11),
+    sc.ScenarioSpec(max_clock=MAX_CLOCK, delay_kind="uniform",
+                    commit_chain=2, seed=22),
+    sc.ScenarioSpec(max_clock=MAX_CLOCK, delay_kind="pareto",
+                    delay_pareto_scale=2.0, delay_pareto_alpha=2.5,
+                    drop_prob=0.05, seed=33),
+    sc.ScenarioSpec(max_clock=MAX_CLOCK, byz_kind="equivocate", byz_f=1,
+                    commit_chain=2, seed=44),
+]
+assert len(SPECS) == SERVE_SLOTS
+
+
+def leaves_with_paths(st):
+    return [(jax.tree_util.keystr(k), np.asarray(jax.device_get(v)))
+            for k, v in jax.tree_util.tree_flatten_with_path(st)[0]]
+
+
+def assert_slot_equal(ded_state, het_state, slot: int):
+    """Every non-scenario leaf of the heterogeneous fleet's ``slot`` row
+    must equal the dedicated run bit-for-bit."""
+    ded = leaves_with_paths(ded_state)
+    het = leaves_with_paths(het_state)
+    assert len(ded) == len(het)
+    for (ka, a), (kb, b) in zip(ded, het):
+        if ".sc_delay" in ka or ".sc_commit" in ka:
+            continue  # the plane rows themselves (zero-width on ded side)
+        assert np.array_equal(a, b[slot]), f"slot {slot} leaf {ka} differs"
+
+
+def dedicated_run(spec: sc.ScenarioSpec, base: SimParams, engine=S):
+    """The static batch-mode reference: scenario plane OFF, this
+    scenario's knobs as compile-time params."""
+    p_i = spec.to_params(base)
+    eq, silent, forge = spec.byz_masks(base)
+    st = engine.init_state(p_i, spec.seed, byz_equivocate=eq,
+                           byz_silent=silent, byz_forge_qc=forge)
+    return p_i, engine.run_to_completion(p_i, st, chunk=FLEET_CHUNK)
+
+
+# ---------------------------------------------------------------------------
+# (a) heterogeneous-fleet parity.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_heterogeneous_fleet_bit_identical_and_oracle_pinned():
+    st = sc.init_specs(P_SC, SPECS)
+    st = S.run_to_completion(P_SC, st, batched=True, chunk=FLEET_CHUNK)
+    for i, spec in enumerate(SPECS):
+        p_i, ded = dedicated_run(spec, P_BASE)
+        assert_slot_equal(ded, st, i)
+        # Oracle pin: the slot's counters and committed chains replay the
+        # per-event reference semantics of exactly this scenario.
+        eq, silent, forge = (np.asarray(m) for m in spec.byz_masks(P_BASE))
+        orc = OracleSim(p_i, spec.seed, byz_equivocate=list(eq),
+                        byz_silent=list(silent),
+                        byz_forge_qc=list(forge)).run()
+        assert int(jax.device_get(st.n_events)[i]) == orc.n_events
+        H = int(st.ctx.log_depth.shape[-1])
+        cc = np.asarray(jax.device_get(st.ctx.commit_count))[i]
+        ld = np.asarray(jax.device_get(st.ctx.log_depth))[i]
+        lt = np.asarray(jax.device_get(st.ctx.log_tag))[i]
+        for a in range(p_i.n_nodes):
+            chain = [(int(ld[a, j % H]), int(lt[a, j % H]))
+                     for j in range(max(int(cc[a]) - H, 0), int(cc[a]))]
+            assert chain == orc.committed_chain(a), (i, a)
+
+
+@pytest.mark.slow
+def test_heterogeneous_fleet_lane_engine():
+    """The lane engine serves the same heterogeneous plane: per-slot
+    bit-identity against dedicated lane runs (no inbox overflow at the
+    micro shape, so window composition is trajectory-invariant)."""
+    base = SimParams(max_clock=MAX_CLOCK, **FLEET_LANE_KW)
+    p_sc = dataclasses.replace(base, scenario=True)
+    specs = [
+        sc.ScenarioSpec(max_clock=MAX_CLOCK, delay_kind="uniform", seed=5),
+        sc.ScenarioSpec(max_clock=MAX_CLOCK, delay_kind="uniform",
+                        commit_chain=2, seed=6),
+        sc.ScenarioSpec(max_clock=MAX_CLOCK, delay_kind="constant",
+                        delay_mean=7.0, byz_kind="silent", byz_f=1, seed=7),
+        sc.ScenarioSpec(max_clock=MAX_CLOCK, delay_kind="uniform",
+                        drop_prob=0.02, seed=8),
+    ]
+    st = sc.init_specs(p_sc, specs, engine=PS)
+    st = PS.run_to_completion(p_sc, st, batched=True, chunk=FLEET_CHUNK)
+    for i, spec in enumerate(specs):
+        _, ded = dedicated_run(spec, base, engine=PS)
+        assert_slot_equal(ded, st, i)
+
+
+@pytest.mark.slow
+def test_knob_default_plane_is_inert():
+    """A scenario-armed fleet carrying knob-DEFAULT rows is bit-identical
+    to the plain static engine — the census/R6 'plane off the hot path'
+    claim, run dynamically."""
+    seeds = [101, 102, 103, 104]
+    rows = [sc.default_row(P_SC, s) for s in seeds]
+    st = sc.init_rows(P_SC, sc.stack_rows(rows))
+    st = S.run_to_completion(P_SC, st, batched=True, chunk=FLEET_CHUNK)
+    for i, seed in enumerate(seeds):
+        ded = S.run_to_completion(
+            P_BASE, S.init_state(P_BASE, seed), chunk=FLEET_CHUNK)
+        assert_slot_equal(ded, st, i)
+
+
+# ---------------------------------------------------------------------------
+# (b) admission isolation.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_admission_leaves_live_slots_bit_identical():
+    short = sc.ScenarioSpec(max_clock=40, seed=55)       # halts early
+    specs = [SPECS[0], SPECS[1], short, SPECS[3]]
+    run = S.make_run_fn(P_SC, FLEET_CHUNK, batched=True)
+
+    def chunks(st, k):
+        for _ in range(k):
+            st = run(st)
+        return st
+
+    n1, n2 = 3, 8
+    # Undisturbed reference: n1 + n2 chunks straight through.
+    ref = chunks(S.dedupe_buffers(sc.init_specs(P_SC, specs)), n1 + n2)
+    # Disturbed run: after n1 chunks the short slot has halted; admit a
+    # NEW scenario into it and keep going.
+    st = chunks(S.dedupe_buffers(sc.init_specs(P_SC, specs)), n1)
+    halted = np.asarray(jax.device_get(st.halted))
+    assert halted[2] and not halted[[0, 1, 3]].any()
+    new_spec = sc.ScenarioSpec(max_clock=MAX_CLOCK, delay_kind="uniform",
+                               commit_chain=2, seed=66)
+    donor_row = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x)),
+        sc.init_slot(P_SC, new_spec.plane_row(P_SC)))
+    donor = jax.tree.map(
+        lambda r: np.broadcast_to(r, (SERVE_SLOTS,) + r.shape).copy(),
+        donor_row)
+    mask = np.zeros((SERVE_SLOTS,), bool)
+    mask[2] = True
+    st = sc.install_rows(st, jnp.asarray(mask), donor)
+    st = chunks(st, n2)
+    # Live slots: bit-identical to the undisturbed run.
+    ref_l = leaves_with_paths(ref)
+    got_l = leaves_with_paths(st)
+    for (ka, a), (_, b) in zip(ref_l, got_l):
+        for slot in (0, 1, 3):
+            assert np.array_equal(a[slot], b[slot]), \
+                f"admission perturbed live slot {slot} leaf {ka}"
+    # The admitted slot equals a fresh dedicated run of the new scenario
+    # advanced the same n2 chunks (halted slots make extra chunks no-ops).
+    p_new = new_spec.to_params(P_BASE)
+    run_new = S.make_run_fn(p_new, FLEET_CHUNK, batched=False)
+    ded_st = S.dedupe_buffers(S.init_state(p_new, new_spec.seed))
+    for _ in range(n2):
+        ded_st = run_new(ded_st)
+    ded_l = leaves_with_paths(ded_st)
+    for (ka, a), (_, b) in zip(ded_l, got_l):
+        if ".sc_delay" in ka or ".sc_commit" in ka:
+            continue
+        assert np.array_equal(a, b[2]), f"admitted slot leaf {ka} differs"
+
+
+# ---------------------------------------------------------------------------
+# (c) the resident loop's poll + compile contracts.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_resident_loop_digest_only_and_one_compile(monkeypatch, tmp_path):
+    if len(jax.devices()) < SERVE_DP:
+        pytest.skip("needs virtual devices (conftest sets 8)")
+    mesh = mesh_ops.make_mesh(n_dp=SERVE_DP, n_mp=1,
+                              devices=jax.devices()[:SERVE_DP])
+    before = len([e for e in tledger.get().compiles
+                  if str(e.get("engine", "")).startswith("sharded")])
+    svc = ResidentFleet(P_BASE, slots=SERVE_SLOTS, mesh=mesh,
+                        chunk=SERVE_CHUNK,
+                        out=str(tmp_path / "serve.ndjson"))
+    digest_fetches = []
+    real_get = jax.device_get
+
+    def spy(x):
+        if np.shape(x) == (tstream.DIGEST_WIDTH,):
+            digest_fetches.append(1)
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", spy)
+    ids = [svc.submit(spec) for spec in SPECS[:3]]  # 3 distinct configs
+    res = svc.drain()
+    monkeypatch.undo()
+    svc.close()
+    # One [13] digest per dispatched chunk — no hidden plane polls.
+    assert len(digest_fetches) == svc.chunks_polled > 0
+    # Exactly ONE fleet-chunk compile entry across >= 3 admitted configs.
+    entries = [e for e in tledger.get().compiles
+               if str(e.get("engine", "")).startswith("sharded")]
+    assert len(entries) - before == 1, \
+        [e.get("structural") for e in entries]
+    # Results exist, are tagged, and match their dedicated references.
+    for rid, spec in zip(ids, SPECS[:3]):
+        r = res[rid]
+        assert r["request_id"] == rid and r["safe"] is True
+        _, ded = dedicated_run(spec, P_BASE)
+        assert r["events"] == int(jax.device_get(ded.n_events))
+        assert r["commits"] == [int(c) for c in
+                                np.asarray(jax.device_get(
+                                    ded.ctx.commit_count))]
+    # The NDJSON stream replays the lifecycle (fleet_watch --serve input).
+    rows = [json.loads(line)
+            for line in (tmp_path / "serve.ndjson").read_text().splitlines()]
+    events = [r for r in rows if r.get("kind") == "request"]
+    assert {e["event"] for e in events} >= {"submitted", "admitted",
+                                            "first_chunk", "egressed"}
+    egressed = [e for e in events if e["event"] == "egressed"]
+    assert {e["id"] for e in egressed} == set(ids)
+    assert all(e["ttfc_s"] is not None for e in egressed)
+
+
+@pytest.mark.slow
+def test_service_checkpoint_preemption_round_trip(tmp_path):
+    """Preemption/eviction: a mid-flight service checkpoints, restores,
+    and finishes with the same results as an uninterrupted one."""
+    if len(jax.devices()) < SERVE_DP:
+        pytest.skip("needs virtual devices (conftest sets 8)")
+    mesh = mesh_ops.make_mesh(n_dp=SERVE_DP, n_mp=1,
+                              devices=jax.devices()[:SERVE_DP])
+    specs = [SPECS[0], SPECS[1]]
+    ref = ResidentFleet(P_BASE, slots=SERVE_SLOTS, mesh=mesh,
+                        chunk=SERVE_CHUNK)
+    for i, s in enumerate(specs):
+        ref.submit(s, request_id=f"q{i}")
+    ref_res = ref.drain()
+    svc = ResidentFleet(P_BASE, slots=SERVE_SLOTS, mesh=mesh,
+                        chunk=SERVE_CHUNK)
+    for i, s in enumerate(specs):
+        svc.submit(s, request_id=f"q{i}")
+    svc.serve(max_chunks=3)  # partially served, then preempted
+    ck = str(tmp_path / "svc.npz")
+    svc.save(ck)
+    svc.close()
+    resumed = ResidentFleet.restore(ck, P_BASE, mesh=mesh)
+    res = resumed.drain()
+    resumed.close()
+    assert set(res) == {"q0", "q1"}
+    for rid in res:
+        for key in ("events", "clock", "commits", "safe"):
+            assert res[rid][key] == ref_res[rid][key], (rid, key)
+
+
+# ---------------------------------------------------------------------------
+# Host-side units (fast; run inside the 870 s suite too).
+# ---------------------------------------------------------------------------
+
+
+def test_spec_validation_and_round_trip():
+    spec = sc.ScenarioSpec(delay_kind="pareto", commit_chain=2,
+                           byz_kind="silent", byz_f=1, seed=9)
+    assert sc.ScenarioSpec.from_dict(spec.to_dict()) == spec
+    with pytest.raises(ValueError, match="unknown scenario field"):
+        sc.ScenarioSpec.from_dict({"delay_knid": "uniform"})
+    with pytest.raises(ValueError, match="Byzantine schedule"):
+        sc.ScenarioSpec(byz_kind="omission")
+    with pytest.raises(ValueError, match="commit_chain"):
+        sc.ScenarioSpec(commit_chain=4)
+    # The dedicated-run projection carries every scenario knob.
+    p_i = spec.to_params(P_BASE)
+    assert (p_i.delay_kind, p_i.commit_chain) == ("pareto", 2)
+    assert not p_i.scenario
+
+
+def test_structural_key_coarsens_under_scenario():
+    """The executable-count collapse, stated on the key itself: scenario
+    params differing in every per-slot knob share one structural key."""
+    a = dataclasses.replace(
+        P_SC, delay_kind="pareto", drop_prob=0.2, commit_chain=2,
+        max_clock=77)
+    b = dataclasses.replace(
+        P_SC, delay_kind="constant", delay_mean=3.0, commit_chain=3)
+    assert a.structural() == b.structural() == P_SC.structural()
+    # Scenario OFF keeps commit_chain structural (the static family).
+    off2 = dataclasses.replace(P_BASE, commit_chain=2)
+    assert off2.structural() != P_BASE.structural()
+
+
+def test_scenario_params_guard():
+    with pytest.raises(ValueError, match="scenario=True"):
+        sc.init_rows(P_BASE, sc.stack_rows([sc.default_row(P_BASE, 0)]))
+
+
+def test_load_requests_ndjson(tmp_path):
+    path = tmp_path / "req.ndjson"
+    path.write_text(
+        '{"id": "a", "delay_kind": "uniform", "commit_chain": 2}\n'
+        "# comment\n"
+        '{"seed": 3}\n')
+    reqs = serve_api.load_requests(str(path))
+    assert [rid for rid, _ in reqs] == ["a", "3"]
+    assert reqs[0][1].commit_chain == 2
+    bad = tmp_path / "bad.ndjson"
+    bad.write_text('{"delay_knid": "x"}\n')
+    with pytest.raises(ValueError, match="bad.ndjson:1"):
+        serve_api.load_requests(str(bad))
+    empty = tmp_path / "empty.ndjson"
+    empty.write_text("# nothing\n")
+    with pytest.raises(ValueError, match="no requests"):
+        serve_api.load_requests(str(empty))
+
+
+def test_schedule_registry():
+    from librabft_simulator_tpu.sim import byzantine
+
+    eq, silent, forge = byzantine.schedule_masks(P_BASE, "honest", 2)
+    assert not (np.asarray(eq).any() or np.asarray(silent).any()
+                or np.asarray(forge).any())
+    eq, silent, forge = byzantine.schedule_masks(P_BASE, "silent", 1)
+    assert np.asarray(silent).sum() == 1 and not np.asarray(eq).any()
+    with pytest.raises(ValueError, match="unknown Byzantine schedule"):
+        byzantine.schedule_masks(P_BASE, "nope")
