@@ -134,6 +134,12 @@ if os.environ.get("BENCH_MACRO") or os.environ.get("BENCH_MACRO_CHILD"):
     # either.
     os.environ.setdefault("BENCH_PLATFORM", "cpu")
 
+if os.environ.get("BENCH_POD"):
+    # The multi-process pod ladder is CPU-emulated by definition (local
+    # jax.distributed processes over loopback; the real-slice rung lives
+    # on the ROADMAP tunnel checklist).
+    os.environ.setdefault("BENCH_PLATFORM", "cpu")
+
 if (__name__ == "__main__" and not os.environ.get("BENCH_SUPERVISED")
         and not os.environ.get("BENCH_PLATFORM")):
     _supervise()  # never returns
@@ -944,6 +950,15 @@ def run_macro_ladder(out_path: str) -> dict:
 
 
 def main():
+    if os.environ.get("BENCH_POD"):
+        # The multi-process pod ladder (scripts/fleet_pod.py): each rung
+        # is its own jax.distributed job, so the harness runs in a fresh
+        # parent process that never attached a backend of its own.
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "fleet_pod.py")])
+        sys.exit(r.returncode)
     if os.environ.get("BENCH_MACRO_CHILD"):
         print(json.dumps(_macro_child()))
         return

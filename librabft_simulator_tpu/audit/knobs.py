@@ -92,6 +92,23 @@ KNOBS: tuple[Knob, ...] = (
          "Stream the service's digest + request-lifecycle NDJSON here "
          "(admission queue depth, slot occupancy, per-request ttfc); "
          "follow live with scripts/fleet_watch.py --serve."),
+    Knob("LIBRABFT_DIST_COORD", "engine", "distributed/bootstrap.py",
+         "host:port",
+         "Multi-process fleet: the jax.distributed coordinator address "
+         "(the standard pod-launcher triple with _NPROC/_PID; "
+         "local_cluster sets all three for its children).  Unset or "
+         "_NPROC<=1: single-process, nothing initializes."),
+    Knob("LIBRABFT_DIST_NPROC", "engine", "distributed/bootstrap.py",
+         "int >= 1",
+         "Multi-process fleet: total process count of the job.  > 1 "
+         "arms jax.distributed.initialize (gloo collectives on CPU) at "
+         "bootstrap.init_from_env(); the 'dp' mesh then spans every "
+         "process's devices."),
+    Knob("LIBRABFT_DIST_PID", "engine", "distributed/bootstrap.py",
+         "0..NPROC-1",
+         "Multi-process fleet: this process's id within the job "
+         "(required, with _COORD, whenever _NPROC > 1 — a partial "
+         "triple fails loud)."),
     Knob("LIBRABFT_AOT_WRITE", "engine", "utils/aot.py", "0|1",
          "Export freshly compiled chunk executables back into the AOT "
          "store on a miss (default off; warm_cache children set it). "
@@ -180,6 +197,29 @@ KNOBS: tuple[Knob, ...] = (
          "rung runs a second cold process with LIBRABFT_AOT=0, landing "
          "ttfc_aot (store-loaded) vs ttfc_jit (trace+lower+compile) in "
          "the RUNTIME_LEDGER artifact.  0 = production leg only."),
+    Knob("BENCH_POD", "bench", "bench.py", "1",
+         "Run the multi-process pod ladder (scripts/fleet_pod.py): "
+         "1/2/4 REAL jax.distributed processes over a loopback "
+         "coordinator, per-host digest streams + ledger spans + "
+         "checkpoint-shard egress, MULTIHOST_FLEET artifact "
+         "(CPU-emulated; ~1/P efficiency caveat)."),
+    Knob("BENCH_POD_PROCS", "bench", "scripts/fleet_pod.py", "p1,p2,...",
+         "Pod-ladder rungs in process count (default 1,2,4)."),
+    Knob("BENCH_POD_B", "bench", "scripts/fleet_pod.py", "int",
+         "Pod ladder: instances PER PROCESS (weak scaling; default 64)."),
+    Knob("BENCH_POD_STEPS", "bench", "scripts/fleet_pod.py", "int",
+         "Pod ladder: macro-steps per dispatched chunk (default 16)."),
+    Knob("BENCH_POD_REPS", "bench", "scripts/fleet_pod.py", "int",
+         "Pod ladder: minimum dispatched chunks per rung (default 4)."),
+    Knob("BENCH_POD_OUT", "bench", "scripts/fleet_pod.py", "path",
+         "Pod-ladder artifact path (default MULTIHOST_FLEET_r15.json)."),
+    Knob("BENCH_POD_AOT_DIR", "bench", "scripts/fleet_pod.py", "path",
+         "Pod ladder: the per-topology AOT store the rungs warm "
+         "(default /tmp/librabft_aot_pod).  Multi-process CPU cannot "
+         "share the persistent XLA cache across processes (the device "
+         "assignment rides the cache key on non-GPU platforms), so the "
+         "store is how rung reruns — and real pods — skip every "
+         "process's recompile."),
     # --- fuzz -----------------------------------------------------------
     Knob("FUZZ_PACKED", "fuzz", "scripts/fuzz_parity.py", "0|1",
          "Run every fuzz trial on the packed-plane engine."),

@@ -1,0 +1,12 @@
+"""Multi-process (pod-scale) fleet runtime.
+
+``bootstrap`` wires ``jax.distributed`` (env knobs + the local CPU
+cluster test harness), ``egress`` lands results/telemetry/checkpoints
+per host, ``elastic`` is the resize/failover path, and ``workers`` holds
+the cluster worker targets.  See each module's docstring; the chunk
+program itself lives untouched in ``parallel/sharded.py`` — this package
+is host-side orchestration only (zero traced ops)."""
+
+from .bootstrap import (  # noqa: F401
+    DistContext, LocalClusterError, context, global_mesh, init_from_env,
+    local_cluster, spawn_cluster)

@@ -1,0 +1,333 @@
+"""Per-host shard-local egress: results, telemetry, and checkpoint shards
+land process-by-process — the full fleet never crosses a host boundary.
+
+The fleet runtime was built shard-by-shard from the start
+(``plane.fold_planes`` partials, ``unpad``'s block walk,
+``load_sharded``'s per-device placement); this module is the
+multi-process face of that discipline:
+
+* :func:`local_spans` names the GLOBAL batch rows this process owns
+  (pure mesh arithmetic — no array fetch), and :func:`local_state`
+  host-lands exactly those rows, padding-trimmed.
+* :func:`host_stream_path` / :func:`host_meta` give every process its
+  own NDJSON digest stream (``<base>.p<pid>.ndjson``, meta-tagged with
+  the process id) — merge/follow them as one fleet with
+  ``scripts/fleet_watch.py --merge 'base.p*.ndjson'``.
+* :func:`save_shards` writes this host's checkpoint shard
+  (``<dir>/shard-<pid>.npz`` + sidecar) and :func:`merge_shards`
+  (the host-0 merge step) assembles the shard set back into ONE
+  standard batched checkpoint that ``sim/checkpoint.py`` loads anywhere
+  — on P' != P processes, or a different device count entirely
+  (``load_sharded`` pads-and-masks): the elastic resize/failover path
+  (distributed/elastic.py).
+* :func:`fold_metric_dicts` merges per-host ``merged_metrics`` partials
+  (each host folds only its addressable shards) into the fleet view
+  with the registry's per-metric aggregation.
+
+Host-side only — zero traced ops; the single traced helper in this
+subsystem (:func:`make_halted_gather`, the resident service's
+between-chunks slot gather) is OUTSIDE the audited chunk program and
+never runs in the fleet hot loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def local_spans(mesh, batch: int, n_valid: int | None = None,
+                process_index: int | None = None) -> list[tuple[int, int]]:
+    """The global ``[start, stop)`` batch row spans owned by this process
+    on ``mesh``, in ascending order, trimmed to ``n_valid`` (padding rows
+    never egress).  Pure mesh arithmetic — derivable before any array
+    exists, so checkpoint sidecars and result tags agree with placement
+    by construction (the batch dim is split over ('dp', 'mp') in device
+    order: device *d* owns rows ``[d*b, (d+1)*b)``)."""
+    import jax
+
+    devices = list(mesh.devices.flat)
+    if batch % len(devices):
+        raise ValueError(
+            f"batch {batch} does not tile the mesh's {len(devices)} "
+            "devices (pad first: parallel.sharded.pad_to_multiple)")
+    per = batch // len(devices)
+    pid = (jax.process_index() if process_index is None else process_index)
+    n_valid = batch if n_valid is None else n_valid
+    spans = []
+    for i, d in enumerate(devices):
+        if getattr(d, "process_index", 0) != pid:
+            continue
+        start, stop = i * per, min((i + 1) * per, n_valid)
+        if stop > start:
+            spans.append((start, stop))
+    # Adjacent spans merge so shard files stay compact.
+    merged: list[tuple[int, int]] = []
+    for s, e in spans:
+        if merged and merged[-1][1] == s:
+            merged[-1] = (merged[-1][0], e)
+        else:
+            merged.append((s, e))
+    return [tuple(se) for se in merged]
+
+
+def local_state(state, n_valid: int):
+    """Host-land this process's valid rows of a device fleet state —
+    the per-leaf block walk of ``parallel.sharded.unpad``, usable on
+    divisible (unpadded) fleets too.  Already-host (numpy) trees pass
+    through unchanged (they ARE the local rows, by the unpad contract)."""
+    import jax
+
+    from ..parallel import sharded
+
+    leaves = jax.tree_util.tree_leaves(state)
+    if leaves and isinstance(leaves[0], np.ndarray):
+        return state
+    if sharded.batch_size(state) == n_valid:
+        # Divisible fleet: unpad would return the device tree as-is;
+        # force the block walk with the true batch as the trim bound.
+        def trim(x):
+            blocks = {}
+            for sh in x.addressable_shards:
+                start = sh.index[0].start or 0 if sh.index else 0
+                if start not in blocks:
+                    blocks[start] = np.asarray(sh.data)
+            return np.concatenate(
+                [blocks[s] for s in sorted(blocks)], axis=0)
+
+        return jax.tree.map(trim, state)
+    return sharded.unpad(state, n_valid)
+
+
+def local_rows_at(state, indices):
+    """Host-land SPECIFIC global rows from this process's shards:
+    ``{global_index: host_row_tree}`` for every index this process can
+    address (others are simply absent).  One small device-side row
+    gather per (leaf, shard block) — O(k) host transfer, never the
+    whole local shard (the resident service's egress discipline: a pod
+    host with hundreds of slots lands only the finished ones)."""
+    import jax
+
+    idx = sorted(set(int(i) for i in indices))
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+
+    def pick(x) -> dict:
+        rows: dict = {}
+        for sh in x.addressable_shards:
+            start = sh.index[0].start or 0 if sh.index else 0
+            n = int(sh.data.shape[0])
+            offs = [(g, g - start) for g in idx
+                    if start <= g < start + n and g not in rows]
+            if not offs:
+                continue
+            block = np.asarray(jax.device_get(
+                sh.data[np.asarray([o for _, o in offs])]))
+            for j, (g, _) in enumerate(offs):
+                rows[g] = block[j]
+        return rows
+
+    picked = [pick(leaf) for leaf in leaves]
+    present = set(picked[0]) if picked else set()
+    return {g: jax.tree_util.tree_unflatten(treedef,
+                                            [p[g] for p in picked])
+            for g in idx if g in present}
+
+
+# ---------------------------------------------------------------------------
+# Per-host digest streams.
+# ---------------------------------------------------------------------------
+
+
+def host_stream_path(base: str, process_id: int) -> str:
+    """The per-host NDJSON stream path convention:
+    ``fleet.ndjson`` -> ``fleet.p3.ndjson`` (fleet_watch --merge globs
+    ``fleet.p*.ndjson``)."""
+    root, ext = os.path.splitext(base)
+    return f"{root}.p{process_id}{ext or '.ndjson'}"
+
+
+def host_meta(ctx) -> dict:
+    """The meta fields a per-host TimelineRecorder carries so merged
+    views can tag every row with its writer."""
+    return {"process_id": ctx.process_id,
+            "process_count": ctx.process_count}
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint shards (save per host; merge on host 0).
+# ---------------------------------------------------------------------------
+
+SHARD_VERSION = 1
+
+
+def _shard_paths(d: str, pid: int) -> tuple[str, str]:
+    return (os.path.join(d, f"shard-{pid}.npz"),
+            os.path.join(d, f"shard-{pid}.json"))
+
+
+def save_shards(d: str, state, n_valid: int, mesh, ctx) -> str:
+    """Write THIS process's checkpoint shard: its local valid rows (one
+    block per owned span) + a sidecar naming the spans.  Every process
+    calls this; none ever holds another host's rows.  Returns the .npz
+    path.  ``state`` may be the device fleet or the host tree
+    ``run_sharded`` already landed."""
+    import jax
+
+    from ..sim import checkpoint as ckpt
+
+    os.makedirs(d, exist_ok=True)
+    leaves = jax.tree_util.tree_leaves(state)
+    # A device fleet carries the padded batch on its leaves; a host tree
+    # landed by unpad holds local rows only, so the padded batch is
+    # re-derived from the mesh (the pad_to_multiple rule).
+    padded = (int(leaves[0].shape[0])
+              if leaves and not isinstance(leaves[0], np.ndarray)
+              else _padded_batch(mesh, n_valid))
+    host = local_state(state, n_valid)
+    spans = local_spans(mesh, padded, n_valid,
+                        process_index=ctx.process_id)
+    rows = sum(e - s for s, e in spans)
+    host_leaves = jax.tree_util.tree_leaves(host)
+    if host_leaves and int(host_leaves[0].shape[0]) != rows:
+        raise ValueError(
+            f"local state holds {int(host_leaves[0].shape[0])} rows but "
+            f"this process owns spans {spans} ({rows} rows) — state and "
+            "mesh disagree")
+    arrays, _ = ckpt._flatten_with_paths(host)
+    blob = {}
+    off = 0
+    for j, (s, e) in enumerate(spans):
+        for key, arr in arrays.items():
+            blob[f"b{j}:{key}"] = arr[off:off + (e - s)]
+        off += e - s
+    bin_path, meta_path = _shard_paths(d, ctx.process_id)
+    tmp = bin_path + ".tmp.%d.npz" % os.getpid()
+    np.savez_compressed(tmp, **blob)
+    os.replace(tmp, bin_path)
+    side = {
+        "shard_version": SHARD_VERSION,
+        "process_id": ctx.process_id,
+        "process_count": ctx.process_count,
+        "n_valid": int(n_valid),
+        "spans": [[int(s), int(e)] for s, e in spans],
+    }
+    tmp = meta_path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(side, f, indent=1)
+    os.replace(tmp, meta_path)
+    return bin_path
+
+
+def _padded_batch(mesh, n_valid: int) -> int:
+    per = max(int(mesh.size), 1)
+    return -(-n_valid // per) * per
+
+
+def merge_shards(d: str, out_path: str | None = None) -> str:
+    """The host-0 merge step: assemble every ``shard-<pid>`` pair in
+    ``d`` into ONE standard batched checkpoint (.npz, the
+    ``sim/checkpoint.py`` format) covering rows ``[0, n_valid)`` exactly.
+    Refuses gaps, overlaps, and mixed fleets loudly — a failover restart
+    from an incomplete shard set must never silently resume a partial
+    fleet.  Returns the merged path (default ``<d>/merged.npz``)."""
+    sidecars = []
+    for name in sorted(os.listdir(d)):
+        if name.startswith("shard-") and name.endswith(".json"):
+            with open(os.path.join(d, name)) as f:
+                sidecars.append(json.load(f))
+    if not sidecars:
+        raise FileNotFoundError(f"no checkpoint shards under {d}")
+    for side in sidecars:
+        if side.get("shard_version") != SHARD_VERSION:
+            raise ValueError(
+                f"{d}: shard-{side.get('process_id')} has shard_version "
+                f"{side.get('shard_version')} != {SHARD_VERSION}")
+    n_valid = {side["n_valid"] for side in sidecars}
+    if len(n_valid) != 1:
+        raise ValueError(f"{d}: shards disagree on n_valid ({n_valid}) — "
+                         "mixed fleets?")
+    n_valid = n_valid.pop()
+    covered: list[tuple[int, int]] = []
+    pieces: dict[str, list[tuple[int, np.ndarray]]] = {}
+    for side in sidecars:
+        data = np.load(_shard_paths(d, side["process_id"])[0])
+        for j, (s, e) in enumerate(side["spans"]):
+            covered.append((s, e))
+            for key in data.files:
+                if not key.startswith(f"b{j}:"):
+                    continue
+                pieces.setdefault(key.split(":", 1)[1], []).append(
+                    (s, data[key]))
+    covered.sort()
+    pos = 0
+    for s, e in covered:
+        if s != pos:
+            raise ValueError(
+                f"{d}: shard set covers rows up to {pos} then jumps to "
+                f"{s} — missing or overlapping shard (a failover restart "
+                "needs every host's shard; recover the missing "
+                f"shard-<pid> files or re-checkpoint)")
+        pos = e
+    if pos != n_valid:
+        raise ValueError(f"{d}: shard set covers [0, {pos}) but n_valid="
+                         f"{n_valid} — incomplete shard set")
+    merged = {key: np.concatenate(
+        [arr for _, arr in sorted(blocks, key=lambda kv: kv[0])], axis=0)
+        for key, blocks in pieces.items()}
+    out_path = out_path or os.path.join(d, "merged.npz")
+    tmp = out_path + ".tmp.%d.npz" % os.getpid()
+    np.savez_compressed(tmp, **merged)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+# ---------------------------------------------------------------------------
+# Telemetry fold merge (host-0 step over per-host partial dicts).
+# ---------------------------------------------------------------------------
+
+
+def fold_metric_dicts(p, dicts: list[dict]) -> dict:
+    """Merge per-host ``telemetry.report.merged_metrics`` partials into
+    the fleet view: counters/histograms sum, high-water marks max — the
+    registry's aggregation per metric (the associativity
+    ``fold_planes`` already guarantees shard-by-shard)."""
+    from ..telemetry import plane
+
+    dicts = list(dicts)
+    if not dicts:
+        raise ValueError("fold_metric_dicts needs at least one partial")
+    out: dict = {}
+    for name, (_, size, agg) in plane.np_registry(p).items():
+        vals = [d[name] for d in dicts]
+        if size == 1:
+            out[name] = (max(vals) if agg == plane.MAX else sum(vals))
+        else:
+            cols = list(zip(*vals))
+            out[name] = [
+                (max(c) if agg == plane.MAX else sum(c)) for c in cols]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Resident-service slot gather (multi-process serve boundary).
+# ---------------------------------------------------------------------------
+
+
+def make_halted_gather(mesh):
+    """A tiny jitted all-gather of the ``[B]`` halted plane, replicated
+    to every process — the resident service's between-chunks egress
+    trigger needs the SAME finished-slot list on every controller (its
+    admission bookkeeping must stay SPMD-consistent), and the plane is
+    batch-sharded.  One [B] bool vector per egress event, never in the
+    chunk loop, never part of the audited chunk program."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    f = shard_map(lambda h: jax.lax.all_gather(h, axes, tiled=True),
+                  mesh=mesh, in_specs=(P(axes),), out_specs=P(),
+                  check_rep=False)
+    return jax.jit(f)

@@ -1,0 +1,119 @@
+"""Elastic resize + failover: a fleet checkpointed on P processes
+restarts on P' != P — including P' = fewer after killing a host mid-run.
+
+The substrate was already in place: ``load_sharded`` pads-and-masks when
+the new device count doesn't divide the batch, trajectories are
+layout-independent (fleet_seeds + the sharded==unsharded pins), and
+chunk boundaries are deterministic — so a restart from the last
+checkpoint is bit-equal to the uninterrupted run no matter what
+topology it resumes on.  This module makes that a first-class path:
+
+* :func:`resume` — merge a per-host shard set
+  (``distributed.egress.save_shards``) and place it on ANY mesh: a
+  2-process fleet's shards restart on 1 process, 4, or a different
+  device count entirely.
+* :func:`resize_under_fire` — the failover referee: run a local cluster,
+  wait for its mid-run checkpoint, SIGKILL one process while the fleet
+  is still dispatching (the survivors wedge in a collective and are
+  reaped — exactly a pod losing a host), then resume on FEWER processes
+  from the surviving shard files and run to completion.  The caller
+  verifies bit-equality against an uninterrupted run
+  (tests/test_distributed.py pins it leaf-for-leaf).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def resume(shard_dir: str, p, engine=None, out_path: str | None = None):
+    """Restart a fleet from a per-host checkpoint shard set: merge and
+    return ``(host_state, n_valid)`` — feed it straight to
+    ``run_sharded`` on ANY mesh (any process count, any device count;
+    padding-and-masking happens there when the topology doesn't divide
+    the batch).
+
+    Deliberately a HOST tree, not ``load_sharded``'s callback-placed
+    arrays: the resumed fleet usually dispatches an AOT-store executable,
+    and on this toolchain a deserialized executable aborts the process on
+    callback-constructed inputs — ``device_put``-placed arrays (what
+    ``run_sharded``'s shard_batch does) are the supported form (the same
+    hard-won rule as ``ResidentFleet.restore``).  The host staging copy
+    is the merge step's own cost, paid once per restart."""
+    import jax
+    import numpy as np
+
+    from ..sim import checkpoint as ckpt
+    from ..sim import simulator as S
+    from . import egress
+
+    eng = engine if engine is not None else S
+    merged = egress.merge_shards(shard_dir, out_path=out_path)
+    sample = np.load(merged)["clock"]
+    like = jax.eval_shape(
+        lambda: eng.init_batch(p, np.zeros(sample.shape[0], np.uint32)))
+    return ckpt.load(merged, p, like=like), int(sample.shape[0])
+
+
+def _wait_for(path: str, timeout_s: float, what: str) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"{what} not observed within {timeout_s}s "
+                               f"({path} never appeared)")
+        time.sleep(0.2)
+
+
+def resize_under_fire(n: int, kwargs: dict, *, victim: int = 1,
+                      timeout_s: float = 600, workdir: str | None = None
+                      ) -> dict:
+    """Kill one local-cluster process mid-run and report the crash scene.
+
+    Phase A only (the resume is the caller's, on whatever topology they
+    want): spawn an *n*-process cluster running
+    ``distributed.workers:fleet_phase`` with ``kwargs`` (which must set
+    ``ckpt_dir`` and ``keep_firing=True`` — every process checkpoints its
+    shard at the agreed chunk boundary, then keeps dispatching), wait for
+    EVERY host's shard to land, then SIGKILL process ``victim`` while the
+    fleet is under fire.  Survivors wedge in the next cross-process
+    collective (a dead gloo peer) and are reaped — the pod-loses-a-host
+    failure mode, end to end.  Returns ``{"ckpt_dir", "workdir",
+    "victim", "returncodes"}``; resume with :func:`resume` on P' < P."""
+    from . import bootstrap
+
+    ckpt_dir = kwargs.get("ckpt_dir")
+    if not ckpt_dir:
+        raise ValueError("kwargs must carry ckpt_dir (where the per-host "
+                         "shards land)")
+    if not kwargs.get("keep_firing"):
+        raise ValueError("kwargs must set keep_firing=True — the kill "
+                         "must land while the fleet is still dispatching")
+    if not 0 <= victim < n:
+        raise ValueError(f"victim {victim} out of range for n={n}")
+    handle = bootstrap.spawn_cluster(
+        n, "librabft_simulator_tpu.distributed.workers:fleet_phase",
+        kwargs, workdir=workdir)
+    try:
+        for pid in range(n):
+            _wait_for(os.path.join(ckpt_dir, f"shard-{pid}.json"),
+                      timeout_s, f"process {pid}'s checkpoint shard")
+        # Every shard is on disk; the fleet is still dispatching
+        # (keep_firing).  Pull the trigger.
+        handle.kill(victim)
+        deadline = time.monotonic() + 60
+        while handle.procs[victim].poll() is None:
+            if time.monotonic() > deadline:
+                raise TimeoutError("victim survived SIGKILL?")
+            time.sleep(0.1)
+    finally:
+        # Survivors are wedged in a collective whose peer is gone; reap
+        # them — a real orchestrator would do exactly this before
+        # rescheduling the job on the remaining hosts.
+        handle.terminate_all()
+    return {
+        "ckpt_dir": ckpt_dir,
+        "workdir": handle.workdir,
+        "victim": victim,
+        "returncodes": [proc.poll() for proc in handle.procs],
+    }

@@ -1,0 +1,229 @@
+"""Cluster worker targets: the functions ``local_cluster`` children run.
+
+Each worker is called as ``fn(ctx, **kwargs)`` inside an initialized
+``jax.distributed`` process (bootstrap._child_main) and returns a
+JSON-serializable summary.  They are the shared substrate of the
+2-process parity referees (tests/test_distributed.py), the ci_tier1
+local-cluster smoke, the pod ladder bench (scripts/fleet_pod.py), and
+the resize-under-fire failover harness (distributed/elastic.py) — one
+implementation of "run the sharded fleet multi-process and egress
+per host" for all of them.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def _engine(name: str):
+    from ..sim import parallel_sim, simulator
+
+    return parallel_sim if name == "parallel" else simulator
+
+
+def _digest_rows(recorder) -> list[dict]:
+    """The deterministic digest columns of a recorder's rows (wall-clock
+    and derived-rate fields stripped) — the cross-topology comparison
+    payload."""
+    from ..telemetry import stream as tstream
+
+    keep = [name for name, _ in tstream.DIGEST_SLOTS]
+    return [dict({k: r[k] for k in keep}, chunk=r["chunk"],
+                 steps=r["steps"]) for r in recorder.rows]
+
+
+def fleet_run(ctx, params_kw: dict, engine: str = "serial", b: int = 5,
+              seeds_base: int = 0, chunk: int = 32,
+              num_steps: int | None = None, out_dir: str | None = None,
+              pin_poll: bool = True, reps_floor: int = 0) -> dict:
+    """Run one sharded fleet over the GLOBAL (multi-process) mesh and
+    egress per host: result shard (``out_dir/``), per-host digest stream
+    NDJSON, per-host telemetry partial — plus the digest-poll contract
+    counters (``pin_poll``: exactly one [13] fetch per dispatched chunk
+    IN THIS PROCESS, the monkeypatch pin of test_multichip restated per
+    host).  ``reps_floor`` forces at least that many dispatched chunks
+    (the pod bench's timed window) by raising num_steps."""
+    import numpy as np
+
+    from ..core.types import SimParams
+    from ..parallel import sharded
+    from ..telemetry import report as treport
+    from ..telemetry import stream as tstream
+    from . import bootstrap, egress
+
+    p = SimParams(**params_kw)
+    eng = _engine(engine)
+    mesh = bootstrap.global_mesh()
+    seeds = sharded.fleet_seeds(seeds_base, b)
+    st = eng.init_batch(p, seeds)
+    # Host-staged init: every process builds the identical numpy fleet
+    # (layout-independent by fleet_seeds) and shard_batch places each
+    # host's rows — the multi-process device_put contract.
+    import jax
+
+    st = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), st)
+    num_steps = num_steps if num_steps is not None else chunk * 200
+    if reps_floor:
+        num_steps = max(num_steps, chunk * reps_floor)
+
+    fetched: list[tuple] = []
+    dispatched: list[int] = []
+    real_poll = sharded._poll_digest
+    real_make = sharded.make_sharded_run_fn
+
+    def spy_poll(dg):
+        out = real_poll(dg)
+        fetched.append(tuple(np.shape(out)))
+        return out
+
+    def make_counting(*a, **kw):
+        run = real_make(*a, **kw)
+
+        def counting(state):
+            dispatched.append(1)
+            return run(state)
+
+        return counting
+
+    rec = None
+    stream_path = None
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        stream_path = egress.host_stream_path(
+            os.path.join(out_dir, "fleet.ndjson"), ctx.process_id)
+        rec = tstream.TimelineRecorder(
+            p, out=stream_path, meta=egress.host_meta(ctx))
+    if pin_poll:
+        sharded._poll_digest = spy_poll
+        sharded.make_sharded_run_fn = make_counting
+    t0 = time.perf_counter()
+    try:
+        final = sharded.run_sharded(p, mesh, st, num_steps=num_steps,
+                                    chunk=chunk, engine=eng, stream=rec)
+    finally:
+        if pin_poll:
+            sharded._poll_digest = real_poll
+            sharded.make_sharded_run_fn = real_make
+    elapsed = time.perf_counter() - t0
+    spans = egress.local_spans(mesh, egress._padded_batch(mesh, b), b,
+                               process_index=ctx.process_id)
+    out = {
+        "process_id": ctx.process_id,
+        "process_count": ctx.process_count,
+        "global_devices": int(jax.device_count()),
+        "local_devices": int(jax.local_device_count()),
+        "spans": [[s, e] for s, e in spans],
+        "elapsed_s": round(elapsed, 3),
+        "chunks_polled": len(fetched) if pin_poll else None,
+        "chunks_dispatched": len(dispatched) if pin_poll else None,
+        "poll_shapes_ok": (all(s == (tstream.DIGEST_WIDTH,)
+                               for s in fetched) if pin_poll else None),
+        "stream": stream_path,
+        "digest_rows": _digest_rows(rec) if rec is not None else None,
+    }
+    if rec is not None:
+        last = rec.rows[-1] if rec.rows else {}
+        out["final_digest"] = {k: last.get(k)
+                               for k, _ in tstream.DIGEST_SLOTS}
+        out["events"] = last.get("events")
+        rec.close()
+    if out_dir:
+        # Per-host result shard (the checkpoint format doubles as the
+        # result egress — the merged fleet state IS the result) + the
+        # per-host telemetry partial when the plane is armed.
+        egress.save_shards(os.path.join(out_dir, "result.d"), final, b,
+                           mesh, ctx)
+        if p.telemetry:
+            host_rows = egress.local_state(final, b)
+            out["telemetry_partial"] = treport.merged_metrics(p, host_rows)
+    return out
+
+
+def fleet_phase(ctx, params_kw: dict, engine: str = "serial", b: int = 5,
+                seeds_base: int = 0, chunk: int = 32,
+                stop_chunks: int = 2, ckpt_dir: str | None = None,
+                keep_firing: bool = False, fire_chunks: int = 10_000
+                ) -> dict:
+    """The failover worker: run exactly ``stop_chunks`` chunks, save this
+    host's checkpoint shard at the boundary, then (``keep_firing``)
+    resume from the just-written shard set and keep dispatching — the
+    window in which :func:`elastic.resize_under_fire` kills a process.
+    Deterministic by construction: the shard set captures the fleet at a
+    chunk boundary, so a restart from it on ANY topology continues
+    bit-identically."""
+    import jax
+    import numpy as np
+
+    from ..core.types import SimParams
+    from ..parallel import sharded
+    from . import bootstrap, egress, elastic
+
+    p = SimParams(**params_kw)
+    eng = _engine(engine)
+    mesh = bootstrap.global_mesh()
+    st = eng.init_batch(p, sharded.fleet_seeds(seeds_base, b))
+    st = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), st)
+    mid = sharded.run_sharded(p, mesh, st, num_steps=stop_chunks * chunk,
+                              chunk=chunk, engine=eng)
+    egress.save_shards(ckpt_dir, mid, b, mesh, ctx)
+    if keep_firing:
+        # Barrier on the full shard SET before merging: this process
+        # only wrote its own shard, and a fast host merging before a
+        # slow peer's sidecar lands would die on merge_shards'
+        # incomplete-coverage check (a lost race, not a real gap) —
+        # which would also void the kill-mid-dispatch window the
+        # failover harness needs.
+        deadline = time.monotonic() + 120
+        for pid in range(ctx.process_count):
+            side = os.path.join(ckpt_dir, f"shard-{pid}.json")
+            while not os.path.exists(side):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"peer shard {side} never appeared (120s)")
+                time.sleep(0.1)
+        # Under fire: restart from the shard set (all hosts read the
+        # same files — shared fs in the local cluster, the object store
+        # on a pod) and keep the dispatch queue busy until killed.
+        host, _ = elastic.resume(
+            ckpt_dir, p, engine=eng,
+            out_path=os.path.join(ckpt_dir, f"fire-p{ctx.process_id}.npz"))
+        sharded.run_sharded(p, mesh, host,
+                            num_steps=fire_chunks * chunk, chunk=chunk,
+                            engine=eng)
+    return {"process_id": ctx.process_id, "saved": True,
+            "ckpt_dir": ckpt_dir}
+
+
+def serve_smoke(ctx, params_kw: dict, specs: list[dict], slots: int = 4,
+                chunk: int = 32, out_dir: str | None = None) -> dict:
+    """Multi-process resident-service smoke: every controller submits
+    the IDENTICAL request sequence (the multi-controller discipline),
+    serves to drain, and reports its host-local egressed results."""
+    from ..core.types import SimParams
+    from ..serve.service import ResidentFleet
+    from . import bootstrap, egress
+
+    p = SimParams(**params_kw)
+    mesh = bootstrap.global_mesh()
+    out = None
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        out = egress.host_stream_path(
+            os.path.join(out_dir, "serve.ndjson"), ctx.process_id)
+    with ResidentFleet(p, slots=slots, mesh=mesh, chunk=chunk,
+                       out=out, meta=egress.host_meta(ctx)) as svc:
+        rids = [svc.submit(spec) for spec in specs]
+        svc.serve(max_chunks=200)
+        local = sorted(svc.results)
+        return {
+            "process_id": ctx.process_id,
+            "submitted": rids,
+            "egressed_local": local,
+            "results": {rid: {k: svc.results[rid][k]
+                              for k in ("events", "commits", "safe",
+                                        "slot")}
+                        for rid in local},
+            "pending": svc.pending_count,
+            "active": svc.active_count,
+        }

@@ -129,21 +129,36 @@ def unpad(state, n_valid: int):
     (telemetry folds and DataWriter decode fetch to host regardless), and
     ``checkpoint.load_sharded`` re-places a host tree onto a mesh without
     full-leaf staging when the fleet runs again.  Unsharded/host states
-    keep the plain slice."""
+    keep the plain slice.
+
+    MULTI-PROCESS meshes (distributed/bootstrap.py) land only the rows
+    this process can address — each block is trimmed against its GLOBAL
+    batch offset, so a host owning rows ``[s, e)`` gets exactly its valid
+    slice and the full fleet never crosses a process boundary (the
+    per-host egress contract; ``distributed.egress.local_spans`` names
+    the rows).  Single-process fleets see the identical result via the
+    same path (all blocks present, globally contiguous)."""
     if batch_size(state) == n_valid:
         return state
 
     def trim(x):
         shards = getattr(x, "addressable_shards", None)
-        if shards is None or len(shards) <= 1:
+        fully_local = getattr(getattr(x, "sharding", None),
+                              "is_fully_addressable", True)
+        if shards is None or (fully_local and len(shards) <= 1):
             return x[:n_valid]
         blocks = {}
         for sh in shards:  # dedup replicated copies by batch span
             start = sh.index[0].start or 0 if sh.index else 0
             if start not in blocks and start < n_valid:
                 blocks[start] = np.asarray(sh.data)
+        if not blocks:
+            # A process can own ONLY padding rows (e.g. b=5 over 4
+            # processes pads to 8 and the last process holds [6, 8)):
+            # its local valid slice is legitimately empty.
+            return np.zeros((0,) + tuple(x.shape[1:]), x.dtype)
         return np.concatenate(
-            [blocks[s] for s in sorted(blocks)], axis=0)[:n_valid]
+            [blocks[s][:n_valid - s] for s in sorted(blocks)], axis=0)
 
     return jax.tree.map(trim, state)
 

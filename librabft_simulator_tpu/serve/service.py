@@ -41,6 +41,7 @@ import jax
 import numpy as np
 
 from ..core.types import SimParams
+from ..distributed import egress as degress
 from ..parallel import mesh as mesh_ops
 from ..parallel import sharded
 from ..sim import byzantine
@@ -106,6 +107,23 @@ class ResidentFleet:
         self.mesh = mesh if mesh is not None else mesh_ops.make_mesh(n_dp=1)
         self.slots = -(-slots // self.mesh.size) * self.mesh.size
         self.chunk = int(chunk)
+        # Multi-process meshes (distributed/bootstrap.py): the chunk loop
+        # and admission write are SPMD (every controller runs them with
+        # identical inputs — callers must submit the identical request
+        # sequence on every process, the standard multi-controller
+        # discipline), but the halted plane is batch-sharded, so the
+        # egress trigger needs a tiny all-gather to keep the
+        # finished-slot list — and with it the slot bookkeeping —
+        # consistent across controllers; result rows then land only on
+        # the host that owns the slot (per-host shard-local egress).
+        self._nproc = len({d.process_index
+                           for d in self.mesh.devices.flat})
+        self._local_slots = (
+            {s for a, b in degress.local_spans(self.mesh, self.slots)
+             for s in range(a, b)}
+            if self._nproc > 1 else set(range(self.slots)))
+        self._halted_gather = (degress.make_halted_gather(self.mesh)
+                               if self._nproc > 1 else None)
         # THE resident executable: structural key only (scenario plane
         # armed), built once — every admission reuses it.
         self._run = sharded.make_sharded_run_fn(
@@ -302,17 +320,38 @@ class ResidentFleet:
 
     def _egress(self, st):
         with self._lg.span(tledger.EGRESS, run=self._rid):
-            halted = np.asarray(jax.device_get(st.halted))
+            if self._halted_gather is not None:
+                # Multi-process: the [slots] plane is batch-sharded, and
+                # every controller must see the SAME finished-slot list
+                # (the _active/_pending bookkeeping is SPMD state) — one
+                # replicated all-gather per egress event, outside the
+                # chunk loop.
+                halted = np.asarray(
+                    jax.device_get(self._halted_gather(st.halted)))
+            else:
+                halted = np.asarray(jax.device_get(st.halted))
             done = [s for s, req in sorted(self._active.items())
                     if bool(halted[s])]
             if not done:
                 return st
-            idx = np.asarray(done, np.int32)
-            # Land ONLY the finished rows on host: one gather per leaf
-            # over the k finished slots (the unpad discipline — never the
-            # whole fleet).
-            rows = jax.tree.map(
-                lambda x: np.asarray(jax.device_get(x[idx])), st)
+            if self._halted_gather is not None:
+                # Per-host shard-local landing: this controller fetches
+                # only its OWN finished rows (O(k) device-side row
+                # gathers, never the whole local shard); finished slots
+                # owned elsewhere still clear their _active entry (the
+                # bookkeeping stays consistent) but their result lands on
+                # the owning host's stream/results.
+                rows_by_slot = degress.local_rows_at(
+                    st, [s for s in done if s in self._local_slots])
+                rows = None
+            else:
+                idx = np.asarray(done, np.int32)
+                # Land ONLY the finished rows on host: one gather per
+                # leaf over the k finished slots (the unpad discipline —
+                # never the whole fleet).
+                rows = jax.tree.map(
+                    lambda x: np.asarray(jax.device_get(x[idx])), st)
+                rows_by_slot = None
             for j, slot in enumerate(done):
                 req = self._active.pop(slot)
                 # A scenario that halts within its first executed chunk
@@ -324,12 +363,16 @@ class ResidentFleet:
                     req.first_chunk_t = self._now()
                     self._emit_request(req, "first_chunk")
                 req.egressed_t = self._now()
-                row = jax.tree.map(lambda x, jj=j: x[jj], rows)
-                self.results[req.request_id] = self._result_of(req, row)
+                if rows_by_slot is not None:
+                    row = rows_by_slot.get(slot)  # None: another host owns it
+                else:
+                    row = jax.tree.map(lambda x, jj=j: x[jj], rows)
+                if row is not None:
+                    self.results[req.request_id] = self._result_of(req, row)
                 self._emit_request(
                     req, "egressed",
                     latency_s=round(req.egressed_t - req.submitted_t, 6),
-                    result=self.results[req.request_id])
+                    result=self.results.get(req.request_id))
         return st
 
     def _result_of(self, req: ScenarioRequest, row) -> dict:
@@ -425,6 +468,14 @@ class ResidentFleet:
         round-trip guarantee)."""
         from ..sim import checkpoint as ckpt
 
+        if self._nproc > 1:
+            raise NotImplementedError(
+                "ResidentFleet.save on a multi-process mesh: preemption "
+                "checkpoints of a pod-resident service need the per-host "
+                "shard path (distributed.egress.save_shards) plus a "
+                "host-0 sidecar merge — run the service single-process "
+                "to preempt/resume, or checkpoint the underlying fleet "
+                "with distributed.egress")
         host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)),
                             self._st)
         ckpt.save(path, host)

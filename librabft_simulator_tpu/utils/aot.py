@@ -199,8 +199,8 @@ def _paths(key: str) -> tuple[str, str]:
 def lookup(key: str) -> tuple[str, dict | None]:
     """Probe the store for ``key`` WITHOUT deserializing: returns
     ``(verdict, sidecar)`` where verdict is ``"hit"`` (present, toolchain
-    matches), ``"stale"`` (present, foreign toolchain or store version),
-    or ``"miss"``."""
+    and process topology match), ``"stale"`` (present, foreign toolchain
+    / store version / process count), or ``"miss"``."""
     bin_path, meta_path = _paths(key)
     if not (os.path.exists(bin_path) and os.path.exists(meta_path)):
         return "miss", None
@@ -212,6 +212,18 @@ def lookup(key: str) -> tuple[str, dict | None]:
     if side.get("aot_version") != AOT_VERSION:
         return "stale", side
     if side.get("toolchain") != _cache.toolchain():
+        return "stale", side
+    # Process-topology hazard: the store KEY hashes the GLOBAL device
+    # count, but a serialized executable bakes in the per-process device
+    # assignment — a store built single-host (8 devices, 1 process) and
+    # a pod slice (2 processes x 4) collide on the key while the
+    # executable is wrong for the topology.  The sidecar's process_count
+    # (absent = 1, the pre-field builds, all single-process) makes that
+    # LOUDLY aot-stale instead of silently wrong; single-process stores
+    # stay valid everywhere single-process.
+    import jax
+
+    if int(side.get("process_count") or 1) != jax.process_count():
         return "stale", side
     return "hit", side
 
@@ -290,6 +302,8 @@ def save(skey: str, compiled, compile_s: float | None = None,
     disk must not break the run that compiled the executable)."""
     from jax.experimental import serialize_executable as se
 
+    import jax
+
     bin_path, meta_path = _paths(skey)
     try:
         os.makedirs(store_dir(), exist_ok=True)
@@ -318,6 +332,15 @@ def save(skey: str, compiled, compile_s: float | None = None,
             "trees": trees,
             "compile_s": (round(compile_s, 3)
                           if compile_s is not None else None),
+            # Process topology: the key hashes only the GLOBAL device
+            # count, so the sidecar records the full picture — lookup()
+            # refuses a process-count mismatch (aot-stale), and the
+            # local/global split plus the builder's index are the
+            # operator's diagnosis when it does.
+            "process_count": int(jax.process_count()),
+            "process_index": int(jax.process_index()),
+            "device_count_global": int(jax.device_count()),
+            "device_count_local": int(jax.local_device_count()),
             **meta,
         }
         tmp = meta_path + ".tmp.%d" % os.getpid()

@@ -143,6 +143,17 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_aot.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 aot_rc=$?
 
+echo "=== multi-process local-cluster smoke (tests/test_distributed.py non-slow: 2-process parity + per-host egress + resize-under-fire; children warm /tmp/librabft_aot_dist — the first-ever run pays the export compiles, later runs aot-hit) ==="
+# Hard timeout: a wedged gloo collective (dead peer) must never hang CI —
+# the cluster harness reaps its children, and this cap reaps the harness.
+# The distributed runtime adds ZERO traced ops to the chunk program (the
+# graph_audit --assert-clean gate above re-verifies the sharded flavor's
+# R5 digest-only contract unchanged with distributed/ in the tree).
+timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_distributed.py -q -m 'not slow' -p no:cacheprovider \
+    -p no:xdist -p no:randomly
+dist_rc=$?
+
 echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro / ${SCENARIO_CENSUS_BUDGET} scenario) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-max "${CENSUS_BUDGET}" \
@@ -178,6 +189,10 @@ if [ "$serve_rc" -ne 0 ]; then
 fi
 if [ "$aot_rc" -ne 0 ]; then
     echo "FAIL: AOT store referees rc=$aot_rc" >&2
+    exit 1
+fi
+if [ "$dist_rc" -ne 0 ]; then
+    echo "FAIL: multi-process local-cluster referees rc=$dist_rc" >&2
     exit 1
 fi
 if [ "$census_rc" -ne 0 ]; then

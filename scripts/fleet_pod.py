@@ -1,0 +1,224 @@
+"""Multi-process pod ladder: the fleet bench over 1/2/4 REAL OS processes.
+
+The dp fleet ladder (BENCH_FLEET=1) scales over one process's virtual
+devices; this harness scales over PROCESSES — each rung is a genuine
+``jax.distributed`` job (loopback coordinator, gloo CPU collectives, one
+device per process) running the production pipelined ``run_sharded``
+loop, with per-host digest streams, per-host runtime-ledger spans, and
+per-host checkpoint-shard egress: the full pod-runtime story, CPU-
+emulated until the TPU tunnel revives.
+
+Honest caveat, like MULTICHIP_FLEET_r08: the P processes timeshare this
+host's cores, so the emulated efficiency curve decays ~1/P by
+construction — the artifact certifies the multi-process HARNESS (the
+bootstrap wiring, the per-host egress discipline, the one-digest-per-
+chunk-per-process poll contract, the ledger attribution), not ICI
+scaling.  Real numbers come from rerunning on a pod slice (ROADMAP).
+
+Knobs: BENCH_POD_PROCS (default "1,2,4"), BENCH_POD_B (instances per
+process), BENCH_POD_STEPS (macro-steps per chunk), BENCH_POD_REPS
+(minimum dispatched chunks per rung), BENCH_POD_OUT (artifact path),
+BENCH_POD_AOT_DIR (the per-topology AOT store the rungs warm — on
+multi-process CPU the persistent XLA cache cannot cross processes: jax
+hashes the device assignment into the cache key on every platform but
+GPU, so process 0 hits and every other process recompiles; the AOT
+store, keyed on global device count, is the fix AND the pod
+ship-the-store workflow).  Run directly or via ``BENCH_POD=1 python
+bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROCS_ENV = "BENCH_POD_PROCS"
+B_ENV = "BENCH_POD_B"
+STEPS_ENV = "BENCH_POD_STEPS"
+REPS_ENV = "BENCH_POD_REPS"
+OUT_ENV = "BENCH_POD_OUT"
+AOT_DIR_ENV = "BENCH_POD_AOT_DIR"
+
+DEFAULT_OUT = "MULTIHOST_FLEET_r15.json"
+#: Persistent across runs (like /tmp/jax_cache): rung P's first run
+#: exports, later runs aot-hit in every process.
+DEFAULT_AOT_DIR = "/tmp/librabft_aot_pod"
+
+
+def _rung(procs: int, b_per: int, chunk: int, reps: int, workdir: str
+          ) -> dict:
+    from librabft_simulator_tpu.distributed import bootstrap
+    from librabft_simulator_tpu.telemetry import ledger as tledger
+
+    params_kw = {"n_nodes": 4, "delay_kind": "uniform", "queue_cap": 32,
+                 "epoch_handoff": False, "max_clock": 2**30}
+    out_dir = os.path.join(workdir, f"pod-{procs}")
+    results = bootstrap.local_cluster(
+        procs, "librabft_simulator_tpu.distributed.workers:fleet_run",
+        {"params_kw": params_kw, "engine": "serial", "b": b_per * procs,
+         "chunk": chunk, "num_steps": chunk * reps, "reps_floor": reps,
+         "out_dir": out_dir},
+        timeout_s=1800, workdir=os.path.join(workdir, f"cluster-{procs}"),
+        ledger=True,
+        env_extra={
+            "LIBRABFT_AOT_DIR": os.environ.get(AOT_DIR_ENV,
+                                               "") or DEFAULT_AOT_DIR,
+            "LIBRABFT_AOT_WRITE": "1",
+        })
+    hosts = []
+    for res in results:
+        pid = res["process_id"]
+        ledger_path = os.path.join(workdir, f"cluster-{procs}",
+                                   f"ledger-p{pid}.ndjson")
+        pipe = {}
+        compiles = []
+        try:
+            rows = tledger.read_ndjson(ledger_path)
+            runs = sorted({r["run"] for r in rows
+                           if r.get("kind") == "span"
+                           and r.get("run") is not None})
+            pipe = (tledger.pipeline_stats(rows, run=runs[-1])
+                    if runs else {})
+            compiles = [
+                {k: e.get(k) for k in ("engine", "compile_s",
+                                       "first_call_s", "cache",
+                                       "aot_load_s")}
+                for e in rows if e.get("kind") == "compile"]
+        except (OSError, ValueError):
+            pass
+        # Steady-state ev/s from the digest rows (chunk 0 carries the
+        # compile/load; the digest's events counter is fleet-global).
+        drows = res.get("digest_rows") or []
+        ev_per_s = None
+        if len(drows) >= 2:
+            # t_s is not in digest_rows (deterministic columns only);
+            # fall back to the ledger's chunk spans for the window.
+            span_rows = pipe.get("rows") or []
+            steady = [r for r in span_rows if r["chunk"] >= 1]
+            dt = sum(r["dispatch_s"] + r["poll_s"] for r in steady)
+            dev = drows[-1]["events"] - drows[0]["events"]
+            ev_per_s = round(dev / dt, 1) if dt > 0 else None
+        hosts.append({
+            "process_id": pid,
+            "spans": res["spans"],
+            "chunks_dispatched": res["chunks_dispatched"],
+            "chunks_polled": res["chunks_polled"],
+            "poll_contract_ok": (
+                res["poll_shapes_ok"]
+                and res["chunks_polled"] == res["chunks_dispatched"]),
+            "elapsed_s": res["elapsed_s"],
+            "events_per_sec_steady": ev_per_s,
+            "time_to_first_chunk_s": pipe.get("time_to_first_chunk_s"),
+            "overlap_fraction": pipe.get("overlap_fraction"),
+            "bubble_count": pipe.get("bubble_count"),
+            "dispatch_poll_rows": pipe.get("rows"),
+            "compiles": compiles,
+        })
+    final = results[0].get("final_digest") or {}
+    # Fleet throughput: the digest's events slot is psum-reduced — any
+    # host's steady-state number IS the fleet aggregate.
+    agg = next((h["events_per_sec_steady"] for h in hosts
+                if h["events_per_sec_steady"]), None)
+    return {
+        "processes": procs,
+        "instances": b_per * procs,
+        "per_process_instances": b_per,
+        "chunk_steps": chunk,
+        "chunks": results[0]["chunks_polled"],
+        "events_total": final.get("events"),
+        "events_per_sec": agg,
+        "poll_contract_ok": all(h["poll_contract_ok"] for h in hosts),
+        "digest_streams_identical": all(
+            r["digest_rows"] == results[0]["digest_rows"]
+            for r in results),
+        "per_host": hosts,
+    }
+
+
+def run_ladder(out_path: str | None = None) -> dict:
+    import tempfile
+
+    try:
+        rungs = [int(x) for x in
+                 os.environ.get(PROCS_ENV, "1,2,4").split(",")
+                 if x.strip()]
+    except ValueError:
+        print("fleet_pod: ignoring malformed BENCH_POD_PROCS",
+              file=sys.stderr)
+        rungs = [1, 2, 4]
+    b_per = int(os.environ.get(B_ENV, "64"))
+    chunk = int(os.environ.get(STEPS_ENV, "16"))
+    reps = int(os.environ.get(REPS_ENV, "4"))
+    out_path = out_path or os.environ.get(OUT_ENV, "") or DEFAULT_OUT
+    workdir = tempfile.mkdtemp(prefix="librabft_pod_")
+    rows, failures = [], {}
+    for procs in rungs:
+        try:
+            row = _rung(procs, b_per, chunk, reps, workdir)
+            rows.append(row)
+            print(json.dumps({k: row[k] for k in (
+                "processes", "instances", "events_per_sec",
+                "poll_contract_ok")}), file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 - ladder rung boundary
+            failures[procs] = f"{type(e).__name__}: {e}"[:500]
+            print(f"fleet_pod: rung P={procs} failed "
+                  f"({failures[procs][:200]})", file=sys.stderr)
+    base = next((r["events_per_sec"] for r in rows
+                 if r["processes"] == 1), None)
+    for r in rows:
+        r["scaling_efficiency"] = (
+            round(r["events_per_sec"] / (r["processes"] * base), 3)
+            if base and r["events_per_sec"] else None)
+    art = {
+        "kind": "multihost_fleet_ladder",
+        "platform": "cpu",
+        "emulated": True,
+        "host_cores": os.cpu_count(),
+        "note": "each rung is a REAL multi-process jax.distributed job "
+                "(loopback coordinator, gloo collectives, 1 device per "
+                "process) running the production double-buffered "
+                "run_sharded loop with per-host digest streams, "
+                "per-host ledger spans, and per-host checkpoint-shard "
+                "egress.  The P processes timeshare this host's cores, "
+                "so the emulated efficiency decays ~1/P by construction "
+                "— the artifact certifies the multi-process harness and "
+                "the per-process one-[13]-digest-per-chunk poll "
+                "contract, not ICI scaling; rerun on a pod slice "
+                "(ROADMAP tunnel checklist).  Multi-process CPU cannot "
+                "share the persistent XLA cache across processes (the "
+                "device assignment is hashed into the cache key on "
+                "non-GPU platforms), so the rungs warm the AOT "
+                "executable store instead — the pod "
+                "ship-the-store-to-every-host workflow.",
+        "rungs": rows,
+        "failures": failures,
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"fleet_pod: wrote {out_path} "
+          f"({len(rows)} rungs, {len(failures)} failures)",
+          file=sys.stderr)
+    head = {
+        "metric": "multihost_fleet_events_per_sec",
+        "value": rows[-1]["events_per_sec"] if rows else 0.0,
+        "unit": "events/sec",
+        "processes": rows[-1]["processes"] if rows else 0,
+        "efficiency_curve": {str(r["processes"]): r["scaling_efficiency"]
+                             for r in rows},
+        "poll_contract_ok": all(r["poll_contract_ok"] for r in rows),
+        "artifact": out_path,
+    }
+    print(json.dumps(head))
+    return art
+
+
+def main(argv=None) -> int:
+    art = run_ladder()
+    return 1 if (art["failures"] or not art["rungs"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

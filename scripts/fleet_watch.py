@@ -288,6 +288,143 @@ def show_serve(path: str, out=None) -> int:
     return 0
 
 
+class _MergeView:
+    """The --merge formatter: digest/request rows from MULTIPLE per-host
+    streams as one fleet view, each row tagged with its writer host."""
+
+    def __init__(self, out=sys.stdout):
+        self.out = out
+        self.header_done = False
+
+    def _header(self):
+        print(f"{'host':>5} {'chunk':>5} {'t_s':>8} {'halted':>8} "
+              f"{'events':>10} {'ev/s':>10} {'commits':>8} {'drop':>6} "
+              f"{'rounds':>11}  WATCHDOG/EVENT", file=self.out)
+        self.header_done = True
+
+    def feed(self, obj: dict, host: str) -> None:
+        kind = obj.get("kind")
+        if kind == "meta":
+            treport.require_registry_version(obj.get("registry_version"),
+                                             what=f"stream (host {host})")
+            print(f"# host {host}: n_nodes={obj.get('n_nodes')} "
+                  f"process {obj.get('process_id', '?')}/"
+                  f"{obj.get('process_count', '?')} "
+                  f"registry v{obj.get('registry_version')}", file=self.out)
+            return
+        if not self.header_done:
+            self._header()
+        if kind == "row":
+            rounds = (f"{obj['committed_round_min']}.."
+                      f"{obj['committed_round_max']}")
+            print(f"{host:>5} {obj['chunk']:>5} {obj['t_s']:>8.2f} "
+                  f"{obj['halted']:>8} {obj['events']:>10} "
+                  f"{obj['ev_per_s']:>10.1f} {obj['commits']:>8} "
+                  f"{obj['drops']:>6} {rounds:>11}  "
+                  f"{_flag_names(obj.get('watchdog_flags', 0))}",
+                  file=self.out, flush=True)
+        elif kind == "request":
+            print(f"{host:>5} {'':>5} {obj.get('t_s', 0):>8.2f} "
+                  f"{'':>8} {'':>10} {'':>10} {'':>8} {'':>6} {'':>11}  "
+                  f"request {obj.get('id')} {obj.get('event')}",
+                  file=self.out, flush=True)
+
+
+def _merge_paths(pattern: str) -> list[str]:
+    import glob as _glob
+
+    paths = sorted(_glob.glob(pattern))
+    if not paths:
+        raise ValueError(
+            f"--merge {pattern!r} matched no files (per-host streams are "
+            "named <base>.p<pid>.ndjson — distributed.egress."
+            "host_stream_path)")
+    return paths
+
+
+def _host_label(path: str, meta: dict) -> str:
+    pid = meta.get("process_id")
+    return f"p{pid}" if pid is not None else os.path.basename(path)
+
+
+def show_merge(pattern: str, summary: bool = False, out=None) -> int:
+    """The --merge one-shot view: every matched per-host stream decoded,
+    rows interleaved by wall time, host tag per row.  --summary prints
+    one final-digest JSON per host instead (the digests are mesh-reduced
+    in-graph, so every host's final row reports the whole fleet — the
+    per-host tags are the cross-check)."""
+    out = out if out is not None else sys.stdout
+    streams = []
+    for path in _merge_paths(pattern):
+        meta, rows = tstream.load_ndjson(path)
+        streams.append((path, meta, rows))
+    if summary:
+        doc = {}
+        for path, meta, rows in streams:
+            data = [r for r in rows if r.get("kind") == "row"]
+            last = data[-1] if data else None
+            doc[_host_label(path, meta)] = (
+                None if last is None else
+                {"chunks": len(data), "elapsed_s": last["t_s"],
+                 "final": {n: last[n] for n, _ in tstream.DIGEST_SLOTS}})
+        print(json.dumps(doc, indent=1), file=out)
+        return 0
+    view = _MergeView(out=out)
+    tagged = []
+    for path, meta, rows in streams:
+        host = _host_label(path, meta)
+        view.feed(dict(meta, kind="meta"), host)
+        tagged += [(r.get("t_s", 0), host, r) for r in rows]
+    for _, host, r in sorted(tagged, key=lambda t: (t[0], t[1])):
+        view.feed(r, host)
+    return 0
+
+
+def follow_merge(pattern: str, view: _MergeView, poll_s: float = 0.5,
+                 idle_timeout_s: float | None = None) -> None:
+    """Tail every matched per-host stream live, tagging rows as they
+    land (arrival order across hosts; the per-row t_s orders exactly).
+    The glob is re-evaluated between polls: pod hosts open their streams
+    at staggered times, and a file appearing AFTER the watcher started
+    joins the merged view from its first line."""
+    import glob as _glob
+
+    _merge_paths(pattern)  # zero matches at start: loud exit-1 contract
+    files: dict = {}       # path -> (fh, host label, line buffer)
+    idle = 0.0
+    try:
+        while True:
+            for path in sorted(_glob.glob(pattern)):
+                if path not in files:
+                    files[path] = [open(path), os.path.basename(path), ""]
+            got = False
+            for path, slot in files.items():
+                f, _, _ = slot
+                chunk = f.read()
+                if not chunk:
+                    continue
+                got = True
+                slot[2] += chunk
+                while "\n" in slot[2]:
+                    line, slot[2] = slot[2].split("\n", 1)
+                    if not line.strip():
+                        continue
+                    obj = json.loads(line)
+                    if obj.get("kind") == "meta":
+                        slot[1] = _host_label(path, obj)
+                    view.feed(obj, slot[1])
+            if got:
+                idle = 0.0
+            else:
+                idle += poll_s
+                if idle_timeout_s is not None and idle >= idle_timeout_s:
+                    return
+                time.sleep(poll_s)
+    finally:
+        for slot in files.values():
+            slot[0].close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("path", help="NDJSON stream file (TimelineRecorder out=)")
@@ -307,6 +444,11 @@ def main(argv=None) -> int:
                          "egressed counts, slot occupancy, per-request "
                          "ttfc — plus the digest heartbeat; --once/"
                          "default follow both work")
+    ap.add_argument("--merge", action="store_true",
+                    help="the path is a GLOB over per-host streams "
+                         "(<base>.p<pid>.ndjson, distributed/egress.py): "
+                         "follow/summarize them as one fleet view with a "
+                         "host tag per row; exits 1 on zero matches")
     ap.add_argument("--poll", type=float, default=0.5,
                     help="follow-mode poll interval in seconds")
     ap.add_argument("--idle-timeout", type=float, default=None,
@@ -314,6 +456,13 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
+        if args.merge:
+            if args.once or args.summary:
+                return show_merge(args.path, summary=args.summary)
+            follow_merge(args.path, _MergeView(), poll_s=args.poll,
+                         idle_timeout_s=args.idle_timeout)
+            return 0
+
         if args.ledger:
             return show_ledger(args.path)
 

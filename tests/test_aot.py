@@ -401,3 +401,68 @@ def test_loaded_executable_reused_across_wrappers(store, monkeypatch):
     out2 = simulator.make_run_fn(P_SER, CHUNK)(st2)
     assert _leaves_equal(out1, out2)
     assert dict(aot._LOADED) == loads_before  # same objects, no new loads
+
+
+def test_process_topology_stale(monkeypatch, tmp_path):
+    """Multi-process key hazard: the store key hashes the GLOBAL device
+    count, but a serialized executable bakes in the per-process device
+    assignment — so a sidecar whose process_count doesn't match this
+    process's world is ``stale`` (loudly, on the ledger path), never a
+    silent wrong-topology load.  Pre-field sidecars (no process_count)
+    count as single-process builds: still a hit single-process, stale the
+    moment the loader runs inside a pod."""
+    d = tmp_path / "store"
+    d.mkdir()
+    monkeypatch.setenv(aot.DIR_ENV, str(d))
+    aot.reset_cache()
+
+    def put(key, extra):
+        with open(d / (key + ".bin"), "wb") as f:
+            f.write(b"\x00")
+        side = {"aot_version": aot.AOT_VERSION,
+                "toolchain": ucache.toolchain(), **extra}
+        with open(d / (key + ".json"), "w") as f:
+            json.dump(side, f)
+
+    put("aaaa", {"process_count": 1})   # single-host build
+    put("bbbb", {"process_count": 2})   # pod build
+    put("cccc", {})                     # pre-field sidecar (= 1 process)
+
+    # Single-process world (this suite): 1-process and legacy sidecars
+    # hit; the pod build is stale.
+    assert jax.process_count() == 1
+    assert aot.lookup("aaaa")[0] == "hit"
+    assert aot.lookup("cccc")[0] == "hit"
+    assert aot.lookup("bbbb")[0] == "stale"
+
+    # Pod world (2 processes): the single-host store — including the
+    # legacy sidecar — is loudly stale; the matching pod build hits.
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    assert aot.lookup("aaaa")[0] == "stale"
+    assert aot.lookup("cccc")[0] == "stale"
+    assert aot.lookup("bbbb")[0] == "hit"
+
+
+def test_save_records_process_topology(monkeypatch, tmp_path):
+    """save() stamps the builder's process topology into the sidecar (the
+    diagnosis fields lookup() judges by)."""
+    monkeypatch.setenv(aot.DIR_ENV, str(tmp_path / "s"))
+
+    class FakeCompiled:
+        pass
+
+    # serialize() will fail on the fake; save must return None cleanly —
+    # the topology fields are pinned via a real export elsewhere (slow
+    # leg); here pin the sidecar schema through a monkeypatched
+    # serializer so the test stays compile-free.
+    import jax.experimental.serialize_executable as se
+
+    monkeypatch.setattr(se, "serialize", lambda c: ("payload", None, None))
+    path = aot.save("dddd", FakeCompiled(), compile_s=1.0, engine="x")
+    assert path is not None
+    with open(str(tmp_path / "s" / "dddd.json")) as f:
+        side = json.load(f)
+    assert side["process_count"] == jax.process_count() == 1
+    assert side["process_index"] == 0
+    assert side["device_count_global"] == jax.device_count()
+    assert side["device_count_local"] == jax.local_device_count()

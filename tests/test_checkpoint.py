@@ -277,3 +277,57 @@ def test_watchdog_leaf_restore(tmp_path):
     assert np.asarray(st4.wd).shape == (FLEET_B, 0)
     np.testing.assert_array_equal(np.asarray(st4.ctx.commit_count),
                                   np.asarray(st.ctx.commit_count))
+
+
+def test_topology_change_dp2_to_dp4_and_dp3(tmp_path, monkeypatch):
+    """Elastic-resize substrate: a fleet checkpointed mid-run on a dp=2
+    mesh restores onto dp=4 AND dp=3 (the pad-and-mask path — 5 % 3 and
+    5 % 4 both force pre-halted padding), continues, and the final state
+    is bit-equal to an uninterrupted run — the device count is a pure
+    deployment choice, never a trajectory fork.  Micro shapes from
+    tests/fleet_shapes.py (the warmed contract).  AOT off: this test
+    dispatches on load_sharded's callback-placed arrays, the input form
+    deserialized executables abort on (the ResidentFleet.restore rule);
+    the jit path under test here is fine with them."""
+    from fleet_shapes import FLEET_B, FLEET_CHUNK, FLEET_SER_KW
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+    from librabft_simulator_tpu.parallel import sharded
+
+    monkeypatch.setenv("LIBRABFT_AOT", "0")
+    p = SimParams(max_clock=120, **FLEET_SER_KW)
+    seeds = sharded.fleet_seeds(0, FLEET_B)
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+
+    # Uninterrupted reference (the tier-1 parity fixtures pin this equal
+    # to the unsharded engines already).
+    ref = sharded.run_sharded(p, mesh2, S.init_batch(p, seeds),
+                              num_steps=FLEET_CHUNK * 200,
+                              chunk=FLEET_CHUNK)
+
+    # Mid-run checkpoint at a chunk boundary on dp=2.
+    mid = sharded.run_sharded(p, mesh2, S.init_batch(p, seeds),
+                              num_steps=FLEET_CHUNK * 2, chunk=FLEET_CHUNK)
+    f = str(tmp_path / "dp2.npz")
+    C.save(f, mid)  # mid landed on host (padded odd batch), rows [0, B)
+
+    for n_dp in (4, 3):
+        mesh_new = mesh_ops.make_mesh(n_dp=n_dp, n_mp=1,
+                                      devices=jax.devices()[:n_dp])
+        st, n_valid = C.load_sharded(f, p, mesh_new)
+        assert n_valid == FLEET_B
+        padded = -(-FLEET_B // n_dp) * n_dp
+        assert int(st.clock.shape[0]) == padded
+        # Padding rows are born halted; real rows carry whatever the
+        # mid-run state says (some may have halted naturally already).
+        assert np.asarray(st.halted)[FLEET_B:].all()
+        # Continue on the NEW topology to completion; the pre-placed
+        # state is already padded, so run_sharded pads zero more.
+        out = sharded.run_sharded(p, mesh_new, st,
+                                  num_steps=FLEET_CHUNK * 200,
+                                  chunk=FLEET_CHUNK, pad=False)
+        for (pt, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(ref)[0],
+                jax.tree_util.tree_flatten_with_path(out)[0]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)[:FLEET_B],
+                err_msg=f"dp={n_dp}: " + "/".join(str(q) for q in pt))

@@ -1,0 +1,376 @@
+"""Multi-process fleet runtime (distributed/): local-cluster referees.
+
+The acceptance contracts of the pod-scale subsystem, run on REAL
+``jax.distributed`` processes (loopback coordinator, gloo CPU
+collectives, one device per child):
+
+* a 2-process local-cluster fleet run is leaf-BIT-IDENTICAL to the
+  single-process sharded run at the same micro shape, digest stream
+  included, for BOTH engines — and every process fetched exactly one
+  [13] digest per dispatched chunk (the run_sharded poll contract,
+  restated per host);
+* per-host egress: each process writes only its own result shard /
+  NDJSON stream, and the host-0 merge step reassembles the exact fleet;
+* resize-under-fire: a 2-process fleet checkpoints mid-run, one process
+  is SIGKILLed while the fleet is still dispatching, and a 1-process
+  resume from the surviving per-host shards runs to a final state
+  bit-equal to an uninterrupted run.
+
+Cluster children warm a DEDICATED AOT store (/tmp/librabft_aot_dist —
+persistent across runs, like /tmp/jax_cache): on multi-process CPU the
+persistent XLA cache cannot cross processes (jax hashes the device
+assignment into the cache key on every platform but GPU, so process 0
+hits and every other process recompiles ~30 s per run); the AOT store,
+keyed on the GLOBAL device count, is both the fix and the production
+ship-the-store-to-every-host workflow.  First-ever run pays the export
+compiles; afterwards every child aot-hits in a few seconds.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from fleet_shapes import FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.distributed import bootstrap, egress, elastic
+from librabft_simulator_tpu.distributed.workers import _digest_rows
+from librabft_simulator_tpu.parallel import mesh as mesh_ops
+from librabft_simulator_tpu.parallel import sharded
+from librabft_simulator_tpu.sim import checkpoint as C
+from librabft_simulator_tpu.sim import parallel_sim as PE
+from librabft_simulator_tpu.sim import simulator as S
+from librabft_simulator_tpu.telemetry import stream as tstream
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "scripts"))
+import fleet_watch  # noqa: E402
+
+P_SER = SimParams(max_clock=120, **FLEET_SER_KW)
+P_LANE = SimParams(max_clock=150, **FLEET_LANE_KW)
+SEEDS = sharded.fleet_seeds(0, FLEET_B)
+
+#: The cluster children's AOT store: dedicated (never the suite's
+#: default store) and persistent across sessions so only the first-ever
+#: run pays the multi-process export compiles.
+DIST_AOT = {"LIBRABFT_AOT_DIR": "/tmp/librabft_aot_dist",
+            "LIBRABFT_AOT_WRITE": "1"}
+
+ENGINES = {
+    "serial": (S, P_SER),
+    "parallel": (PE, P_LANE),
+}
+
+
+def _cluster_fleet(tmp_path, engine_name: str):
+    _, p = ENGINES[engine_name]
+    out_dir = str(tmp_path / f"out-{engine_name}")
+    results = bootstrap.local_cluster(
+        2, "librabft_simulator_tpu.distributed.workers:fleet_run",
+        {"params_kw": {**dict(FLEET_SER_KW if engine_name == "serial"
+                              else FLEET_LANE_KW),
+                       "max_clock": p.max_clock},
+         "engine": engine_name, "b": FLEET_B, "chunk": FLEET_CHUNK,
+         "out_dir": out_dir},
+        timeout_s=900, workdir=str(tmp_path / f"cluster-{engine_name}"),
+        env_extra=DIST_AOT)
+    return results, out_dir
+
+
+def _reference(engine_name: str):
+    eng, p = ENGINES[engine_name]
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    rec = tstream.TimelineRecorder(p)
+    ref = sharded.run_sharded(p, mesh2, eng.init_batch(p, SEEDS),
+                              num_steps=FLEET_CHUNK * 200,
+                              chunk=FLEET_CHUNK, engine=eng, stream=rec)
+    return ref, rec
+
+
+@pytest.mark.parametrize("engine_name", ["serial", "parallel"])
+def test_two_process_cluster_bit_identical(tmp_path, engine_name):
+    """ACCEPTANCE: the 2-process local-cluster fleet == the
+    single-process sharded run, leaf-for-leaf, digest stream included;
+    exactly one [13] digest fetch per dispatched chunk PER PROCESS
+    (each child's spy restates the test_multichip monkeypatch pin)."""
+    eng, p = ENGINES[engine_name]
+    results, out_dir = _cluster_fleet(tmp_path, engine_name)
+    ref, rec = _reference(engine_name)
+
+    # Per-process digest-poll contract.
+    for res in results:
+        assert res["poll_shapes_ok"], res
+        assert res["chunks_polled"] == res["chunks_dispatched"] > 0
+        assert res["process_count"] == 2 and res["global_devices"] == 2
+
+    # Per-host egress covered disjoint spans of the real fleet.
+    spans = sorted(tuple(s) for r in results for s in r["spans"])
+    assert spans == [(0, 3), (3, 5)]
+
+    # Digest stream: identical across hosts (mesh-reduced in-graph) and
+    # identical to the single-process run's, chunk for chunk.
+    assert results[0]["digest_rows"] == results[1]["digest_rows"]
+    assert results[0]["digest_rows"] == _digest_rows(rec)
+
+    # Host-0 merge of the per-host result shards == the single-process
+    # fleet, bit-for-bit, every leaf.
+    merged = egress.merge_shards(os.path.join(out_dir, "result.d"))
+    like = jax.eval_shape(
+        lambda: eng.init_batch(p, np.zeros(FLEET_B, np.uint32)))
+    got = C.load(merged, p, like=like)
+    for (pt, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(ref)[0],
+            jax.tree_util.tree_flatten_with_path(got)[0]):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg="/".join(str(q) for q in pt))
+
+    # Per-host NDJSON streams carry their writer's identity and decode
+    # under the frozen registry version.
+    for pid in (0, 1):
+        meta, rows = tstream.load_ndjson(
+            os.path.join(out_dir, f"fleet.p{pid}.ndjson"))
+        assert meta["process_id"] == pid and meta["process_count"] == 2
+        assert [r for r in rows if r.get("kind") == "row"]
+
+    # Per-host telemetry partials fold to the single-process fleet view.
+    if p.telemetry:
+        from librabft_simulator_tpu.telemetry import report as treport
+
+        folded = egress.fold_metric_dicts(
+            p, [r["telemetry_partial"] for r in results])
+        assert folded == treport.merged_metrics(p, ref)
+
+
+def test_resize_under_fire(tmp_path):
+    """ACCEPTANCE: kill one process mid-run, resume on fewer from the
+    per-host checkpoint shards, final results bit-equal to an
+    uninterrupted run.  The fleet runs a non-halting horizon so the kill
+    provably lands while chunks are still dispatching; both legs run the
+    same fixed chunk count (deterministic boundaries)."""
+    params_kw = dict(FLEET_SER_KW, max_clock=2**30)
+    p = SimParams(**params_kw)
+    ckpt_dir = str(tmp_path / "ckpt.d")
+    scene = elastic.resize_under_fire(
+        2,
+        {"params_kw": params_kw, "engine": "serial", "b": FLEET_B,
+         "chunk": FLEET_CHUNK, "stop_chunks": 2, "ckpt_dir": ckpt_dir,
+         "keep_firing": True},
+        victim=1, timeout_s=900, workdir=str(tmp_path / "fire"))
+    assert scene["returncodes"][1] is not None  # the victim is dead
+    assert os.path.exists(os.path.join(ckpt_dir, "shard-0.npz"))
+    assert os.path.exists(os.path.join(ckpt_dir, "shard-1.npz"))
+
+    # Resume on FEWER processes (1, here in-process) from the shards the
+    # dead fleet left behind; continue for 4 more chunks.
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    host, n_valid = elastic.resume(ckpt_dir, p)
+    assert n_valid == FLEET_B
+    out = sharded.run_sharded(p, mesh2, host, num_steps=FLEET_CHUNK * 4,
+                              chunk=FLEET_CHUNK)
+
+    # Uninterrupted reference: 6 chunks straight through.
+    ref = sharded.run_sharded(p, mesh2, S.init_batch(p, SEEDS),
+                              num_steps=FLEET_CHUNK * 6, chunk=FLEET_CHUNK)
+    for (pt, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(ref)[0],
+            jax.tree_util.tree_flatten_with_path(out)[0]):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg="/".join(str(q) for q in pt))
+
+
+# ---------------------------------------------------------------------------
+# Cluster-free units (span math, shard save/merge, bootstrap knobs,
+# merge watch) — milliseconds, no child processes, no compiles.
+# ---------------------------------------------------------------------------
+
+
+def test_local_spans_math():
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    # Single process owns everything; padding rows trimmed; adjacent
+    # spans merged.
+    assert egress.local_spans(mesh2, 6, 5, process_index=0) == [(0, 5)]
+    assert egress.local_spans(mesh2, 6, 6, process_index=0) == [(0, 6)]
+    # A process owning no devices of this mesh gets nothing.
+    assert egress.local_spans(mesh2, 6, 5, process_index=3) == []
+    with pytest.raises(ValueError, match="tile"):
+        egress.local_spans(mesh2, 5, 5, process_index=0)
+
+
+def test_shard_save_merge_roundtrip(tmp_path):
+    """save_shards on a (single-process) sharded fleet + merge_shards
+    reassembles the exact batched checkpoint; gaps and mixed fleets are
+    refused loudly."""
+    ctx = bootstrap.DistContext(0, 1, None, False)
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    st = S.init_batch(P_SER, SEEDS)
+    padded, n_valid = sharded.pad_to_multiple(P_SER, st, mesh2.size)
+    dev = mesh_ops.shard_batch(mesh2, padded)
+    d = str(tmp_path / "ck.d")
+    egress.save_shards(d, dev, n_valid, mesh2, ctx)
+    merged = egress.merge_shards(d)
+    like = jax.eval_shape(
+        lambda: S.init_batch(P_SER, np.zeros(FLEET_B, np.uint32)))
+    got = C.load(merged, P_SER, like=like)
+    for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Incomplete shard set: loud refusal, not a partial fleet.
+    side_path = os.path.join(d, "shard-0.json")
+    with open(side_path) as f:
+        side = json.load(f)
+    side["spans"] = [side["spans"][0][:1] + [2]]  # cover only [0, 2)
+    with open(side_path, "w") as f:
+        json.dump(side, f)
+    with pytest.raises(ValueError, match="covers"):
+        egress.merge_shards(d)
+    # Mixed n_valid across shards: also loud.
+    side["spans"] = [[0, 5]]
+    side["n_valid"] = 7
+    with open(side_path, "w") as f:
+        json.dump(side, f)
+    with pytest.raises(ValueError, match="n_valid"):
+        egress.merge_shards(d)
+    with pytest.raises(FileNotFoundError):
+        egress.merge_shards(str(tmp_path / "empty.d"))
+
+
+def test_bootstrap_env_knobs(monkeypatch):
+    """Knob wiring: unset/1 -> the degenerate single-process context
+    (nothing initializes); a partial multi-process triple fails loud."""
+    monkeypatch.setattr(bootstrap, "_CTX", None)
+    monkeypatch.delenv(bootstrap.NPROC_ENV, raising=False)
+    ctx = bootstrap.init_from_env()
+    assert ctx == bootstrap.DistContext(0, 1, None, False)
+    assert not ctx.is_multiprocess and ctx.is_host0
+
+    monkeypatch.setattr(bootstrap, "_CTX", None)
+    monkeypatch.setenv(bootstrap.NPROC_ENV, "2")
+    with pytest.raises(ValueError, match="coordinator triple"):
+        bootstrap.init_from_env()
+    monkeypatch.setenv(bootstrap.COORD_ENV, "127.0.0.1:1")
+    monkeypatch.setenv(bootstrap.PID_ENV, "5")
+    monkeypatch.setattr(bootstrap, "_CTX", None)
+    with pytest.raises(ValueError, match="out of range"):
+        bootstrap.init_from_env()
+    monkeypatch.setattr(bootstrap, "_CTX", None)
+
+    with pytest.raises(ValueError, match=">= 1"):
+        bootstrap.local_cluster(0, "x:y")
+    with pytest.raises(ValueError, match="module:function"):
+        bootstrap._resolve_target("no_colon")
+
+
+def test_fold_metric_dicts():
+    """The host-0 telemetry merge: counters sum, high-water marks max —
+    against merged_metrics on the concatenated fleet."""
+    from librabft_simulator_tpu.telemetry import report as treport
+
+    st = S.run_to_completion(P_SER, S.init_batch(P_SER, SEEDS),
+                             chunk=FLEET_CHUNK, batched=True)
+    host = jax.tree.map(lambda x: np.asarray(x), st)
+    left = jax.tree.map(lambda x: x[:3], host)
+    right = jax.tree.map(lambda x: x[3:], host)
+    folded = egress.fold_metric_dicts(
+        P_SER, [treport.merged_metrics(P_SER, left),
+                treport.merged_metrics(P_SER, right)])
+    assert folded == treport.merged_metrics(P_SER, host)
+    with pytest.raises(ValueError, match="at least one"):
+        egress.fold_metric_dicts(P_SER, [])
+
+
+def test_fleet_watch_merge(tmp_path, capsys):
+    """scripts/fleet_watch.py --merge: two per-host streams render as one
+    host-tagged fleet view; zero glob matches exits 1 with a message,
+    never a traceback."""
+    p = P_SER
+    dg = np.zeros((tstream.DIGEST_WIDTH,), np.int64)
+    dg[tstream.SLOT["events"]] = 7
+    for pid in (0, 1):
+        path = egress.host_stream_path(str(tmp_path / "fleet.ndjson"), pid)
+        rec = tstream.TimelineRecorder(
+            p, total_instances=6, out=path,
+            meta={"process_id": pid, "process_count": 2})
+        rec.record(dg, steps=32)
+        rec.close()
+
+    rc = fleet_watch.main([str(tmp_path / "fleet.p*.ndjson"),
+                           "--merge", "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "host p0" in out and "host p1" in out
+    assert out.count("   p0 ") + out.count("   p1 ") >= 2
+
+    rc = fleet_watch.main([str(tmp_path / "fleet.p*.ndjson"),
+                           "--merge", "--summary"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["p0"]["final"]["events"] == 7 == doc["p1"]["final"]["events"]
+
+    rc = fleet_watch.main([str(tmp_path / "nothing.p*.ndjson"),
+                           "--merge", "--once"])
+    err = capsys.readouterr().err
+    assert rc == 1 and "matched no files" in err
+
+
+def test_unpad_padding_only_process_lands_empty():
+    """A process owning ONLY padding rows (b=5 over 4 single-device
+    processes pads to 8; the last process holds [6, 8)) lands an EMPTY
+    local slice, not a crash — the multi-process block walk of
+    parallel.sharded.unpad."""
+
+    class FakeShard:
+        def __init__(self, start, data):
+            self.index = (slice(start, start + data.shape[0]),)
+            self.data = data
+
+    class FakeSharding:
+        is_fully_addressable = False
+
+    class FakeLeaf:
+        def __init__(self, start, rows, tail=(3,)):
+            self.sharding = FakeSharding()
+            self.dtype = np.int32
+            self.shape = (8,) + tail
+            self.addressable_shards = [
+                FakeShard(start, np.ones((rows,) + tail, np.int32))]
+
+    out = sharded.unpad(FakeLeaf(6, 2), 5)     # rows [6, 8): all padding
+    assert out.shape == (0, 3)
+    mid = sharded.unpad(FakeLeaf(4, 2), 5)     # rows [4, 6): one valid
+    assert mid.shape == (1, 3)
+
+
+def test_host_stream_path_convention():
+    assert egress.host_stream_path("/x/fleet.ndjson", 3) == \
+        "/x/fleet.p3.ndjson"
+    assert egress.host_stream_path("/x/fleet", 0) == "/x/fleet.p0.ndjson"
+
+
+@pytest.mark.slow  # a third cluster launch + the serve executable's
+# multi-process compile; the single-process serve referees (test_serve)
+# and the 2-process fleet parities above cover the shared machinery.
+def test_two_process_serve_smoke(tmp_path):
+    """Multi-process resident service: 2 controllers submit identical
+    requests, the fleet drains, and the union of per-host egressed
+    results covers every request exactly once (per-host shard-local
+    egress — each result lands only on its slot's owner)."""
+    from fleet_shapes import FLEET_SCENARIO_SER_KW
+
+    specs = [{"seed": s, "max_clock": 100} for s in (1, 2, 3)]
+    results = bootstrap.local_cluster(
+        2, "librabft_simulator_tpu.distributed.workers:serve_smoke",
+        {"params_kw": dict(FLEET_SCENARIO_SER_KW, max_clock=100),
+         "specs": specs, "slots": 4, "chunk": FLEET_CHUNK,
+         "out_dir": str(tmp_path / "serve")},
+        timeout_s=900, workdir=str(tmp_path / "cluster-serve"),
+        env_extra=DIST_AOT)
+    for res in results:
+        assert res["pending"] == 0 and res["active"] == 0
+    all_ids = sorted(results[0]["submitted"])
+    local_sets = [set(r["egressed_local"]) for r in results]
+    assert sorted(set().union(*local_sets)) == all_ids
+    assert not (local_sets[0] & local_sets[1])  # disjoint ownership
