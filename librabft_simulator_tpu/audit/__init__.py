@@ -1,24 +1,41 @@
-"""Static-analysis subsystem: jaxpr lint, source lint, checkify sanitizer.
+"""Static-analysis subsystem: jaxpr lint, source lint, runtime-contract
+lints (donation, concurrency, compiled HLO), checkify sanitizer.
 
 Four PRs of perf and observability work rest on invariants that were only
 example-tested until now — "no scalar scatters in TPU-gated graphs" (the
 miscompile class PR 1/2 designed around), "consensus state is int32/uint32
 only", "one host fetch per dispatched chunk", "knob-off graphs are
 bit-identical".  Every one of them is decidable on the traced jaxpr or the
-source AST, so this package enforces them statically:
+source AST, so this package enforces them statically — and since round 16
+the audit also covers the layer the serve/distributed subsystems live in:
+host-side buffer lifetimes, cross-process waits, and the compiled
+executable itself.
 
 * :mod:`.graph_lint` — traces both engines' step functions (every lowering
   flavor) and walks the ClosedJaxpr: rules R1-R6.
 * :mod:`.source_lint` — AST rules over the repo source: host-library calls
   in traced code, unsanctioned host syncs, unregistered env knobs,
-  duplicated CI budget literals.
+  duplicated CI budget literals (S1-S4).
+* :mod:`.donation_lint` — the donation/aliasing verifier (D1-D3): the
+  per-flavor donation map pinned from the staged lowering, the
+  dedupe-before-placement rule (the PR-9 segfault class), and the
+  host use-after-donate rule.
+* :mod:`.concurrency_lint` — host-concurrency rules (C1-C3): every
+  cross-process wait bounded, lock discipline over registered shared
+  state, NDJSON rows flushed per write.
+* :mod:`.hlo_lint` — the compiled-HLO audit (rule ``HLO``): scatter
+  class + site provenance, the digest-only small root, and donation
+  alias survival, read from ``jit(...).lower(...).compile().as_text()``
+  on whatever backend is visible (tunnel checklist item 8's
+  backend-portable half).
 * :mod:`.knobs` — the env-knob registry the source lint checks against
   (and the README "Configuration knobs" table generator).
 * :mod:`.sanitize` — a checkify-instrumented build of both engines'
-  chunk runners behind the ``LIBRABFT_CHECKIFY`` knob; off, the engine
-  graphs are untouched (the census gates pin this transitively).
+  chunk runners behind the ``LIBRABFT_CHECKIFY`` knob (including the
+  scenario-plane flavor); off, the engine graphs are untouched (the
+  census gates pin this transitively).
 
 ``scripts/graph_audit.py`` runs every pass and gates CI via
 ``--assert-clean``; see the README "Static guarantees" section for the
-rule table and the waiver protocol.
+rule tables and the waiver protocol.
 """

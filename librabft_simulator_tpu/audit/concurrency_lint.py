@@ -1,0 +1,348 @@
+"""Host-concurrency lint (C-rules): the cross-process/threading invariants
+of the serve/distributed era, machine-checked on the source AST.
+
+The round-15 distributed runtime added the failure classes no jaxpr rule
+can see: a wedged gloo collective (dead peer) turning into an unbounded
+host wait — the hang class that forced ci_tier1's hard 1500 s cap — and
+shared mutable host state (the runtime ledger, the resident service's
+admission queue) touched from more than one thread.  These rules make the
+working discipline unrepresentable to violate:
+
+C1  **Every cross-process wait is bounded.**  In the hot modules
+    (:data:`C1_SCOPE`): a ``.wait()`` / ``.join()`` call with no timeout
+    and a *blocking* ``fcntl.flock`` (``LOCK_EX`` without ``LOCK_NB``)
+    are errors unless registered in :data:`C1_SANCTIONED` with a
+    justification.  ``ClusterHandle.wait`` takes its deadline
+    positionally by design, the reaper uses ``proc.wait(timeout=...)``,
+    and the AOT manifest lock spins ``LOCK_NB`` against a deadline
+    (``utils/aot._flock_bounded``) — the wedged-collective /
+    dead-writer hang class made a review-time error.
+C2  **Lock discipline over shared mutable state.**  :data:`C2_GUARDED`
+    registers (file, class) -> (owning lock attribute, guarded
+    attributes); every MUTATION of a guarded attribute (assignment,
+    augmented assignment, subscript store, mutating method call —
+    ``append``/``pop``/``update``/...) must be lexically inside a
+    ``with <lock>:`` block.  Single-threaded setup paths are registered
+    in :data:`C2_EXEMPT`.  Reads are deliberately not flagged: the
+    guarded structures tolerate racy point-in-time snapshots
+    (``len(pending)``), never racy mutation.
+C3  **NDJSON rows flush per write.**  The PR-7 contract: a
+    ``timeout``-killed process must leave every completed row on disk,
+    so any function that writes a ``json.dumps`` row to a stream must
+    also ``.flush()`` it (same function).  Was convention; now a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .source_lint import Finding, _attr_chain, _functions, \
+    enclosing_functions, iter_repo_sources
+
+# ---------------------------------------------------------------------------
+# C1 — bounded waits.
+# ---------------------------------------------------------------------------
+
+#: Hot modules where an unbounded wait wedges the fleet/CI: the
+#: distributed runtime and its callers, the serve loop, the parallel
+#: runtime, the AOT store (fcntl manifest lock) and the ledger.
+#: realnode/ (the asyncio reference node) and analysis are host tools
+#: outside the fleet hot path.
+C1_SCOPE_PREFIXES = ("distributed/", "serve/", "parallel/", "utils/",
+                     "telemetry/")
+C1_SCOPE_FILES = ("scripts/fleet_pod.py", "scripts/fleet_serve.py")
+
+#: (file, enclosing function) -> justification for an unbounded wait.
+C1_SANCTIONED: dict = {}
+
+
+def _c1_in_scope(rel: str) -> bool:
+    return rel.startswith(C1_SCOPE_PREFIXES) or rel in C1_SCOPE_FILES
+
+
+def _is_none(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if call.args:
+        # A positional deadline (ClusterHandle.wait(timeout_s)) counts;
+        # a LITERAL None does not — `proc.wait(None)` is the unbounded
+        # form in a bounded costume.  A variable that may hold None
+        # stays best-effort-accepted (lexical lint, not dataflow).
+        return not _is_none(call.args[0])
+    return any(kw.arg in ("timeout", "timeout_s", "deadline")
+               and not _is_none(kw.value) for kw in call.keywords)
+
+
+def lint_c1(rel: str, tree: ast.Module) -> list[Finding]:
+    if not _c1_in_scope(rel):
+        return []
+    findings = []
+    funcs = _functions(tree)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain:
+            continue
+        enclosing = enclosing_functions(funcs, node.lineno)
+        func = enclosing[-1]
+        if any((rel, fname) in C1_SANCTIONED for fname in enclosing):
+            continue
+        name = chain[-1]
+        if name in ("wait", "join") and len(chain) > 1 \
+                and not _has_timeout(node):
+            findings.append(Finding(
+                "C1", "source", "error",
+                f".{name}() without a timeout in {func}() — a dead peer "
+                "(wedged gloo collective, killed child) parks this wait "
+                "forever; pass an explicit bounded timeout, or register "
+                "the site in C1_SANCTIONED with a justification",
+                f"{rel}:{node.lineno}"))
+        elif name == "flock" and len(node.args) >= 2:
+            flags = ast.dump(node.args[1])
+            if "LOCK_EX" in flags and "LOCK_NB" not in flags:
+                findings.append(Finding(
+                    "C1", "source", "error",
+                    f"blocking fcntl.flock(LOCK_EX) in {func}() — a "
+                    "crashed writer holding the lock wedges every later "
+                    "process; spin LOCK_NB against a deadline "
+                    "(utils/aot._flock_bounded)",
+                    f"{rel}:{node.lineno}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# C2 — lock discipline.
+# ---------------------------------------------------------------------------
+
+#: (file, class name or None for module level) ->
+#: (owning lock attribute, frozenset of guarded attributes).
+C2_GUARDED = {
+    ("telemetry/ledger.py", "RuntimeLedger"): ("_lock", frozenset({
+        "spans", "compiles", "unattributed", "_compile_seen", "dropped",
+        "_seq", "_run_seq"})),
+    ("utils/aot.py", None): ("_lock", frozenset({"_LOADED", "_REFUSED"})),
+    ("serve/service.py", "ResidentFleet"): ("_qlock", frozenset({
+        "_pending", "requests", "results"})),
+}
+
+#: (file, function) setup paths that run before any second thread can
+#: exist (constructors, classmethod restore building a fresh instance).
+C2_EXEMPT = {
+    ("telemetry/ledger.py", "__init__"),
+    ("serve/service.py", "__init__"),
+    ("serve/service.py", "restore"),
+}
+
+#: Method calls that mutate their receiver.
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "sort", "reverse"})
+
+
+def _guarded_access(node, attrs: frozenset, cls: str | None):
+    """The guarded attribute named by ``node`` under registry scope
+    ``cls`` (class -> ``self.<attr>``; module level -> bare ``<attr>``),
+    else None."""
+    if cls is not None:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and node.attr in attrs:
+            return node.attr
+        return None
+    if isinstance(node, ast.Name) and node.id in attrs:
+        return node.id
+    return None
+
+
+def _lock_expr_matches(expr, lock: str, cls: str | None) -> bool:
+    if cls is not None:
+        return isinstance(expr, ast.Attribute) and expr.attr == lock \
+            and isinstance(expr.value, ast.Name) and expr.value.id == "self"
+    return isinstance(expr, ast.Name) and expr.id == lock
+
+
+def _mutation_in(node, attrs: frozenset, cls: str | None) -> str | None:
+    """A guarded-attribute MUTATION anywhere in an expression subtree
+    (mutating method call, subscript store/del), else None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _MUTATORS:
+            a = _guarded_access(sub.func.value, attrs, cls)
+            if a:
+                return a
+        elif isinstance(sub, ast.Subscript) \
+                and isinstance(sub.ctx, (ast.Store, ast.Del)):
+            a = _guarded_access(sub.value, attrs, cls)
+            if a:
+                return a
+    return None
+
+
+def _c2_walk(node, lock: str, cls: str | None, attrs: frozenset,
+             under: bool, hits: list) -> None:
+    if isinstance(node, ast.With):
+        # With-item expressions evaluate BEFORE this statement's lock
+        # takes effect: scan them under the OUTER lock state.
+        if not under:
+            for item in node.items:
+                a = _mutation_in(item.context_expr, attrs, cls)
+                if a:
+                    hits.append((node.lineno, a))
+        locked = under or any(
+            _lock_expr_matches(item.context_expr, lock, cls)
+            for item in node.items)
+        for child in node.body:
+            _c2_walk(child, lock, cls, attrs, locked, hits)
+        return
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return  # nested scopes get their own pass
+    if not under:
+        target = None
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for sub in ast.walk(t):
+                    a = _guarded_access(sub, attrs, cls)
+                    if a:
+                        target = a
+        if target is None:
+            if isinstance(node, (ast.If, ast.While, ast.For, ast.Try)):
+                # Compound: bodies recurse below, but the test/iter
+                # expressions execute too — `while pending.pop():` is as
+                # much a mutation as a statement-level pop.
+                for expr in ([node.test]
+                             if isinstance(node, (ast.If, ast.While))
+                             else [node.iter, node.target]
+                             if isinstance(node, ast.For) else []):
+                    a = _mutation_in(expr, attrs, cls)
+                    if a:
+                        target = a
+            else:
+                target = _mutation_in(node, attrs, cls)
+        if target is not None:
+            hits.append((node.lineno, target))
+    for field in ("body", "orelse", "finalbody"):
+        for child in getattr(node, field, []) or []:
+            _c2_walk(child, lock, cls, attrs, under, hits)
+    for handler in getattr(node, "handlers", []) or []:
+        for child in handler.body:
+            _c2_walk(child, lock, cls, attrs, under, hits)
+
+
+def lint_c2(rel: str, tree: ast.Module,
+            guarded: dict | None = None) -> list[Finding]:
+    registry = guarded if guarded is not None else C2_GUARDED
+    entries = [(cls, lock, attrs)
+               for (f, cls), (lock, attrs) in registry.items() if f == rel]
+    if not entries:
+        return []
+    findings = []
+    for fn in _functions(tree):
+        for cls, lock, attrs in entries:
+            if cls is not None and cls not in fn.classes:
+                continue
+            if (rel, fn.name) in C2_EXEMPT:
+                continue
+            hits: list = []
+            for stmt in fn.node.body:
+                _c2_walk(stmt, lock, cls, attrs, False, hits)
+            for lineno, attr in hits:
+                where = f"{cls}.{attr}" if cls else attr
+                findings.append(Finding(
+                    "C2", "source", "error",
+                    f"guarded attribute {where} mutated in {fn.name}() "
+                    f"outside `with {'self.' if cls else ''}{lock}:` — "
+                    "shared mutable state races without the owning lock; "
+                    "take the lock, or register a single-threaded setup "
+                    "path in C2_EXEMPT",
+                    f"{rel}:{lineno}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# C3 — NDJSON flush-per-row.
+# ---------------------------------------------------------------------------
+
+#: (file, function) -> justification for a row write with no flush.
+C3_SANCTIONED: dict = {}
+
+
+def _is_row_write(call: ast.Call) -> bool:
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "write" and call.args):
+        return False
+    for sub in ast.walk(call.args[0]):
+        if isinstance(sub, ast.Call):
+            chain = _attr_chain(sub.func)
+            if chain and chain[-1] == "dumps":
+                return True
+    return False
+
+
+def lint_c3(rel: str, tree: ast.Module) -> list[Finding]:
+    findings = []
+    for fn in _functions(tree):
+        # Writes and flushes are matched BY RECEIVER (the dotted chain
+        # before .write/.flush): flushing stderr while rows buffer on
+        # out_f must not satisfy the rule.
+        rows: dict[tuple, int] = {}
+        flushed: set[tuple] = set()
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_row_write(node):
+                recv = tuple(_attr_chain(node.func)[:-1])
+                rows.setdefault(recv, node.lineno)
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "flush":
+                flushed.add(tuple(_attr_chain(node.func)[:-1]))
+        if (rel, fn.name) in C3_SANCTIONED:
+            continue
+        for recv, lineno in sorted(rows.items(), key=lambda kv: kv[1]):
+            if recv in flushed:
+                continue
+            findings.append(Finding(
+                "C3", "source", "error",
+                f"{fn.name}() writes NDJSON rows (json.dumps -> .write) "
+                f"on {'.'.join(recv) or 'an expression'} without "
+                "flushing that stream — a timeout-killed process loses "
+                "every buffered row (the PR-7 contract: flush per row "
+                "so the stream survives the kill)",
+                f"{rel}:{lineno}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry points.
+# ---------------------------------------------------------------------------
+
+
+def lint_text(rel: str, text: str,
+              guarded: dict | None = None) -> list[Finding]:
+    """C1-C3 on one file's source (fixture entry point, mirroring
+    source_lint.lint_text)."""
+    tree = ast.parse(text)
+    return (lint_c1(rel, tree) + lint_c2(rel, tree, guarded=guarded)
+            + lint_c3(rel, tree))
+
+
+def run(root: str | None = None) -> list[Finding]:
+    """C1-C3 over the repo (source_lint.iter_repo_sources — one shared
+    walk contract for every rule family)."""
+    findings: list[Finding] = []
+    for rel, text in iter_repo_sources(root):
+        try:
+            findings += lint_text(rel, text)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "C1", "source", "error",
+                f"unparseable source: {e}", rel))
+    return findings

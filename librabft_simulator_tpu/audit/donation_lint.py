@@ -1,0 +1,523 @@
+"""Donation & aliasing verifier (D-rules): the buffer-lifetime half of the
+audit — what the jaxpr rules cannot see.
+
+The fleet runtime's whole memory story rides on buffer donation (the chunk
+runner threads a fleet-sized state in place between dispatches), and
+donation has exactly one host-side obligation: every donated buffer must
+be XLA-OWNED.  The PR-9 incident is the canonical violation — a
+checkpoint-restored numpy tree was ``device_put``-placed without
+``dedupe_buffers`` and fed to the donating chunk runner; on the CPU
+backend ``device_put`` of host numpy can ZERO-COPY alias the numpy memory,
+so XLA recycled buffers it did not own (deterministic segfault on the
+second post-restore dispatch).  ``serve/service.py:141``/``:533`` carry
+the hand-threaded fix; these rules make the whole class machine-checked.
+
+Rules
+-----
+
+D1  **Donation map pinned per flavor.**  Every runner flavor is staged
+    (``.lower()`` — trace + StableHLO emission, no XLA compile, so the
+    whole matrix costs seconds like the jaxpr audit) and the per-leaf
+    donation record (``Lowered.args_info``; the emitted modules carry it
+    as ``tf.aliasing_output`` for plain jit, ``jax.buffer_donor`` under
+    shard_map) is read back and checked:
+    every donated leaf lives under the STATE argument and every
+    state leaf is donated — tables, lookahead scalars, admission masks
+    and donors are never donated.  The donated/total leaf counts are
+    pinned in ``scripts/budgets.py`` (``DONATION``), so a donation-map
+    change is a gated diff, not a silent rebaseline.  (The compiled
+    executable's ``input_output_alias`` survival is re-checked by the
+    HLO audit on the flavors it compiles — :mod:`.hlo_lint`.)
+D2  **dedupe-before-placement.**  AST rule over the donation-adjacent
+    modules (:data:`D2_SCOPE`): every host→device placement
+    (``shard_batch`` / ``device_put``) must route its placed value
+    through ``dedupe_buffers`` (the copy that forces every leaf into an
+    XLA-owned buffer), or the (file, function) site must be registered
+    in :data:`D2_SANCTIONED` with a justification — i.e. the exact PR-9
+    bare-``device_put``-into-a-donating-runner path cannot be written
+    without tripping review.
+D3  **Host use-after-donate.**  AST rule: a name passed as the donated
+    argument of a registered donating callable (:data:`D3_DONATING`)
+    and then READ again in the same scope before being rebound is an
+    error — the read dereferences a buffer XLA already recycled.  The
+    safe idiom rebinds in the same statement (``st, dg = run(st)``).
+    Lexical forward scan: straight-line misuse is caught at review
+    time; loop-carried aliasing stays the fuzz/test harness's job.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .source_lint import Finding, _attr_chain, _functions, \
+    enclosing_functions, iter_repo_sources
+
+#: The D1 runner matrix (audit_donation's flavors) — scripts/budgets.py
+#: DONATION must pin exactly this set (tests/test_audit.py checks).
+DONATION_FLAVORS = (
+    "serial/run", "serial/digest", "serial/telemetry", "serial/scenario",
+    "lane/digest", "sharded/digest", "sharded/scenario", "serve/install",
+    "sanitize/serial")
+
+#: D2: donation-adjacent modules — everything that stages host trees onto
+#: the mesh a donating runner consumes (package-relative, plus the serve
+#: and distributed trees wholesale).
+D2_SCOPE_PREFIXES = ("serve/", "distributed/")
+D2_SCOPE_FILES = ("sim/checkpoint.py", "parallel/sharded.py")
+
+#: Placement callees: callee attr name -> index of the PLACED argument.
+_PLACEMENTS = {"shard_batch": 1, "device_put": 0}
+
+#: (file, enclosing function) -> justification.  Every placement in D2
+#: scope that does not visibly route through dedupe_buffers must appear
+#: here.
+D2_SANCTIONED = {
+    ("serve/service.py", "_admit"):
+        "admission donor/mask placement: install_rows donates ONLY its "
+        "state argument (the D1 pin), never the donor or mask — and the "
+        "donor rows are device_get-fetched into fresh host-owned numpy "
+        "the install write only READS; the XLA-owned output is what "
+        "flows onward.",
+}
+
+#: D3: per-file donating callables — dotted callee pattern -> donated
+#: argument index.  These are the runners jitted with donate_argnums
+#: (engine chunk runners, the sharded fleet runner, the admission write).
+D3_DONATING = {
+    "serve/service.py": {"self._run": 0, "sc.install_rows": 0,
+                         "install_rows": 0},
+    "parallel/sharded.py": {"run": 0},
+    "sim/simulator.py": {"run": 0},
+    "sim/parallel_sim.py": {"run": 0},
+    "audit/sanitize.py": {"run": 0},
+}
+
+
+# ---------------------------------------------------------------------------
+# D1 — the lowered donation map.
+# ---------------------------------------------------------------------------
+
+
+def donation_map(jit_fn, args: tuple) -> dict:
+    """Lower ``jit_fn(*args)`` (no XLA compile) and return the donation
+    view: ``{"donated": [leaf paths], "kept": [leaf paths], "total": n}``.
+    Paths are ``jax.tree_util.keystr`` forms over the args tuple, so
+    ``[2].store.hcr`` names arg 2's state leaf.  The map is read from
+    ``Lowered.args_info`` — jax's own per-leaf donation record over the
+    FULL call signature (unused-arg pruning can drop parameters from the
+    emitted module, so the module text alone under-counts); the emitted
+    ``tf.aliasing_output``/``jax.buffer_donor`` markers and the compiled
+    executable's ``input_output_alias`` are re-checked by the HLO audit
+    on the flavors it compiles."""
+    import jax
+
+    lowered = jit_fn.lower(*args)
+    info = lowered.args_info
+    if isinstance(info, tuple) and len(info) == 2 \
+            and isinstance(info[1], dict):
+        info = info[0]  # (args, kwargs) form: kwargs are always empty here
+    flat_info = jax.tree_util.tree_flatten_with_path(info)[0]
+    paths = [jax.tree_util.keystr(k) for k, _ in flat_info]
+    donated = [p for p, (_, info) in zip(paths, flat_info)
+               if getattr(info, "donated", False)]
+    kept = [p for p, (_, info) in zip(paths, flat_info)
+            if not getattr(info, "donated", False)]
+    return {"donated": donated, "kept": kept, "total": len(flat_info)}
+
+
+def check_donation(jit_fn, args: tuple, state_argpos: int | None,
+                   flavor: str, expected_donated: int | None = None
+                   ) -> tuple[list[Finding], dict]:
+    """D1 on one staged runner: every donated leaf under the state
+    argument, every state leaf donated (nothing else ever donated), and
+    the donated count pinned when ``expected_donated`` is given.
+    ``state_argpos=None`` asserts a donation-FREE callable (the checkify
+    sanitizer build: no donation, so no dedupe obligation)."""
+    findings: list[Finding] = []
+    dm = donation_map(jit_fn, args)
+    prefix = None if state_argpos is None else f"[{state_argpos}]"
+    if prefix is None:
+        for p in dm["donated"]:
+            findings.append(Finding(
+                "D1", flavor, "error",
+                f"donation-free contract violated: leaf {p} is donated — "
+                "this callable's callers do not route their inputs "
+                "through dedupe_buffers (re-audit every call site before "
+                "donating here)", ""))
+    else:
+        for p in dm["donated"]:
+            if not p.startswith(prefix):
+                findings.append(Finding(
+                    "D1", flavor, "error",
+                    f"non-state leaf {p} is donated — only the fleet "
+                    "state input may be donated (tables/masks/donors are "
+                    "host-reused across dispatches)", ""))
+        undonated_state = [p for p in dm["kept"] if p.startswith(prefix)]
+        if undonated_state:
+            findings.append(Finding(
+                "D1", flavor, "error",
+                f"{len(undonated_state)} state leaves are NOT donated "
+                f"(first: {undonated_state[0]}) — the chunk runner must "
+                "thread the whole fleet state in place or every chunk "
+                "pays a fleet-sized copy", ""))
+    if expected_donated is not None \
+            and len(dm["donated"]) != expected_donated:
+        findings.append(Finding(
+            "D1", flavor, "error",
+            f"donation-map drift: {len(dm['donated'])} donated leaves vs "
+            f"the pinned {expected_donated} (scripts/budgets.py DONATION) "
+            "— a state leaf was added/removed or a donate_argnums "
+            "changed; re-audit the dedupe call sites and re-pin", ""))
+    stats = {"donated": len(dm["donated"]), "kept": len(dm["kept"]),
+             "total": dm["total"]}
+    return findings, stats
+
+
+def _expected_table() -> dict:
+    """The pinned per-flavor donated-leaf counts from scripts/budgets.py
+    (``DONATION``; absent = unpinned, counts recorded but not gated)."""
+    import os
+
+    from .source_lint import repo_root
+
+    path = os.path.join(repo_root(), "scripts", "budgets.py")
+    ns: dict = {}
+    try:
+        with open(path) as f:
+            exec(compile(f.read(), path, "exec"), ns)  # noqa: S102
+    except FileNotFoundError:
+        return {}
+    return ns.get("DONATION", {})
+
+
+def audit_donation(shape: str = "micro") -> tuple[list[Finding], dict]:
+    """D1 over the runner matrix: both engines' chunk runners (run +
+    digest flavors, the telemetry and scenario twins), the dp-sharded
+    fleet runner (plain + the scenario-armed resident-serve key), the
+    admission write, and the checkify sanitizer build.  Staging only —
+    ``.lower()`` never invokes XLA, so the matrix costs seconds."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.types import SimParams
+    from ..parallel import mesh as mesh_ops
+    from ..parallel import sharded
+    from ..serve import scenario as sc
+    from ..sim import parallel_sim as PE
+    from ..sim import simulator as S
+    from ..utils import xops
+    from . import graph_lint as GL
+
+    ser_kw = dict(GL.CENSUS_KW if shape == "census" else GL.MICRO_SER_KW)
+    lane_kw = dict(GL.CENSUS_KW if shape == "census" else GL.MICRO_LANE_KW)
+    expected = _expected_table()
+    findings: list[Finding] = []
+    stats: dict = {}
+    steps, batch = 2, 3
+
+    def run_check(flavor, jit_fn, args, state_argpos):
+        f, st = check_donation(jit_fn, args, state_argpos, flavor,
+                               expected_donated=expected.get(flavor))
+        findings.extend(f)
+        stats[flavor] = st
+
+    def ser_args(p):
+        st = S.init_batch(p, np.arange(batch, dtype=np.uint32))
+        return (jnp.asarray(p.delay_table()),
+                jnp.asarray(p.duration_table()), st)
+
+    # Serial engine: run + digest twins, then the telemetry and scenario
+    # flavors (each changes the state leaf set, hence the donation map).
+    for name, kw in (("serial/run", {}),
+                     ("serial/digest", {}),
+                     ("serial/telemetry", dict(telemetry=True,
+                                               flight_cap=32)),
+                     ("serial/scenario", dict(scenario=True))):
+        p = xops.resolve_params(
+            SimParams(**ser_kw, **GL.TPU_FORMS, **kw))
+        maker = (S._compiled_run if name == "serial/run"
+                 else S._compiled_digest_run)
+        run_check(name, maker(p.structural(), steps, True), ser_args(p), 2)
+
+    # Lane engine (digest flavor: the stream/fleet contract one).
+    p_lane = xops.resolve_params(
+        SimParams(**lane_kw, **GL.TPU_FORMS))
+    st = PE.init_batch(p_lane, np.arange(batch, dtype=np.uint32))
+    lane_args = (jnp.asarray(p_lane.delay_table()),
+                 jnp.asarray(p_lane.duration_table()),
+                 jnp.asarray(PE.d_min_of(p_lane), jnp.int32), st)
+    run_check("lane/digest",
+              PE._compiled_digest_run(p_lane.structural(), steps, True),
+              lane_args, 3)
+
+    # The dp-sharded fleet runner (the production chunk loop) and its
+    # scenario-armed twin — the resident fleet service's executable key.
+    if len(jax.devices()) < 2:
+        findings.append(Finding(
+            "D1", "sharded/digest", "error",
+            "cannot audit the sharded runner's donation map: <2 devices "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "before importing jax; scripts/graph_audit.py does)", ""))
+    else:
+        mesh = mesh_ops.make_mesh(n_dp=2, n_mp=1,
+                                  devices=jax.devices()[:2])
+        for name, kw in (("sharded/digest", {}),
+                         ("sharded/scenario", dict(scenario=True))):
+            p = xops.resolve_params(
+                SimParams(**ser_kw, **GL.TPU_FORMS, **kw))
+            st = S.init_batch(p, sharded.fleet_seeds(0, 4))
+            st = mesh_ops.shard_batch(mesh, S.dedupe_buffers(st))
+            key_p = dc.replace(p, max_clock=0, drop_prob=0.0)
+            if key_p.scenario:
+                from ..core import types as core_types
+                key_p = dc.replace(key_p, commit_chain=3,
+                                   **core_types.DELAY_KEY_DEFAULTS)
+            run_check(name,
+                      sharded._cached_sharded_run_fn(
+                          key_p, mesh, steps, S, "shard_map"),
+                      (st,), 0)
+
+        # The admission write: state donated, mask and donor NEVER (the
+        # static pin that makes _admit's undeduped donor placement safe —
+        # see D2_SANCTIONED).
+        p_sc = dc.replace(
+            xops.resolve_params(SimParams(**ser_kw, **GL.TPU_FORMS)),
+            scenario=True)
+        rows = sc.init_rows(
+            p_sc, sc.stack_rows([sc.default_row(p_sc, s)
+                                 for s in range(4)]))
+        st_sc = S.dedupe_buffers(rows)
+        mask = jnp.zeros((4,), jnp.bool_)
+        donor = jax.tree.map(jnp.zeros_like, st_sc)
+        run_check("serve/install", sc.install_rows,
+                  (st_sc, mask, donor), 0)
+
+    # The checkify sanitizer build: donation-FREE by contract (its
+    # callers hand it arbitrary externally-held states — doctored
+    # fixtures, checkpoint trees — with no dedupe obligation).
+    from . import sanitize as SAN
+
+    p_san = xops.resolve_params(SimParams(max_clock=500, **ser_kw))
+    st = S.init_batch(p_san, np.arange(batch, dtype=np.uint32))
+    checked = SAN._cached_checked_run(p_san, steps, True, "serial")
+    inner = getattr(checked, "__wrapped__", checked)
+    # wrap_compile/wrap_jit forward lower only for prefix-free runners;
+    # the sanitizer takes just the state, so the staging API is live.
+    run_check("sanitize/serial", inner, (st,), None)
+
+    return findings, stats
+
+
+# ---------------------------------------------------------------------------
+# D2 — dedupe-before-placement (AST).
+# ---------------------------------------------------------------------------
+
+
+def _d2_in_scope(rel: str) -> bool:
+    return rel.startswith(D2_SCOPE_PREFIXES) or rel in D2_SCOPE_FILES
+
+
+def _contains_dedupe(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            chain = _attr_chain(sub.func)
+            if chain and chain[-1] == "dedupe_buffers":
+                return True
+    return False
+
+
+def lint_d2(rel: str, tree: ast.Module) -> list[Finding]:
+    if not _d2_in_scope(rel):
+        return []
+    findings = []
+    funcs = _functions(tree)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain or chain[-1] not in _PLACEMENTS:
+            continue
+        argpos = _PLACEMENTS[chain[-1]]
+        if argpos >= len(node.args):
+            continue
+        placed = node.args[argpos]
+        if _contains_dedupe(placed):
+            continue
+        enclosing = enclosing_functions(funcs, node.lineno)
+        func = enclosing[-1]
+        if any((rel, fname) in D2_SANCTIONED for fname in enclosing):
+            continue
+        findings.append(Finding(
+            "D2", "source", "error",
+            f"{chain[-1]} placement in {func}() does not route through "
+            "dedupe_buffers — a bare device placement of host numpy can "
+            "zero-copy alias host memory, and a donating runner then "
+            "frees buffers XLA does not own (the PR-9 segfault); wrap "
+            "the placed tree in dedupe_buffers, or register the site in "
+            "D2_SANCTIONED with a justification",
+            f"{rel}:{node.lineno}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# D3 — host use-after-donate (AST).
+# ---------------------------------------------------------------------------
+
+
+def _var_key(node):
+    """A trackable donated-argument expression: a bare name ('st') or a
+    self attribute ('self._st'); None for anything else (untrackable
+    expressions are not checkable lexically)."""
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return ("self", node.attr)
+    return None
+
+
+def _stores_in(node) -> set:
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            out.add(("name", sub.id))
+        elif isinstance(sub, ast.Attribute) \
+                and isinstance(sub.ctx, ast.Store) \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == "self":
+            out.add(("self", sub.attr))
+    return out
+
+
+def _loads_in(node, key) -> list[int]:
+    out = []
+    for sub in ast.walk(node):
+        if key[0] == "name" and isinstance(sub, ast.Name) \
+                and isinstance(sub.ctx, ast.Load) and sub.id == key[1]:
+            out.append(sub.lineno)
+        elif key[0] == "self" and isinstance(sub, ast.Attribute) \
+                and isinstance(sub.ctx, ast.Load) \
+                and sub.attr == key[1] \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == "self":
+            out.append(sub.lineno)
+    return out
+
+
+def _sub_suites(stmt) -> list:
+    """The statement suites nested directly in a compound statement."""
+    suites = [getattr(stmt, f, None) for f in ("body", "orelse",
+                                               "finalbody")]
+    suites += [h.body for h in getattr(stmt, "handlers", []) or []]
+    return [s for s in suites if s]
+
+
+def _scan_continuation(stmts: list, key, pattern: str, rel: str,
+                       findings: list) -> bool:
+    """Walk the statements that lexically execute after a donation; flag
+    the first read of ``key``.  Returns True when the scan is RESOLVED
+    (read flagged, name rebound, or control left the function via
+    return/raise) — the caller then skips the ancestor continuations,
+    which only execute on paths this branch never rejoins."""
+    for later in stmts:
+        if isinstance(later, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        # Loads anywhere in the statement — including inside branch
+        # bodies: a read on ANY path after the donation is a potential
+        # use-after-free (loads evaluate before same-statement stores).
+        loads = _loads_in(later, key)
+        if loads:
+            findings.append(Finding(
+                "D3", "source", "error",
+                f"{key[1]} is read after being donated to {pattern}() — "
+                "the buffer was recycled by XLA at dispatch; rebind the "
+                "name from the runner's output (`st, dg = run(st)`) "
+                "before any further use",
+                f"{rel}:{loads[0]}"))
+            return True
+        if key in _stores_in(later):
+            return True  # rebound — later reads see the new buffer
+        if isinstance(later, (ast.Return, ast.Raise, ast.Break,
+                              ast.Continue)):
+            return True  # control leaves this path before any more reads
+    return False
+
+
+def _scan_d3_suite(suite: list, continuations: list, table: dict,
+                   rel: str, findings: list) -> None:
+    """One statement suite: donations found in simple statements scan
+    the suite's own remainder, then the enclosing suites' remainders
+    (``continuations``, innermost first).  Branch suites are scanned
+    separately with the SAME continuation, so a read in a mutually
+    exclusive branch is never attributed to another branch's donation
+    (loop-carried reads remain the fuzz/test harness's job)."""
+    for i, stmt in enumerate(suite):
+        rest = suite[i + 1:]
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # nested scopes are scanned as their own functions
+        subs = _sub_suites(stmt)
+        if subs:
+            for sub in subs:
+                _scan_d3_suite(sub, [rest] + continuations, table, rel,
+                               findings)
+            continue
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            pattern = ".".join(_attr_chain(call.func))
+            argnum = table.get(pattern)
+            if argnum is None or argnum >= len(call.args):
+                continue
+            key = _var_key(call.args[argnum])
+            if key is None:
+                continue
+            if key in _stores_in(stmt):
+                continue  # `st, dg = run(st)` — rebound in place
+            for chunk in [rest] + continuations:
+                if _scan_continuation(chunk, key, pattern, rel,
+                                      findings):
+                    break
+
+
+def lint_d3(rel: str, tree: ast.Module,
+            donating: dict | None = None) -> list[Finding]:
+    table = (donating if donating is not None else D3_DONATING).get(rel)
+    if not table:
+        return []
+    findings: list[Finding] = []
+    for fn in _functions(tree):
+        _scan_d3_suite(fn.node.body, [], table, rel, findings)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry points (source rules; D1 runs from audit_donation).
+# ---------------------------------------------------------------------------
+
+
+def lint_text(rel: str, text: str,
+              donating: dict | None = None) -> list[Finding]:
+    """D2+D3 on one file's source (fixture entry point, mirroring
+    source_lint.lint_text)."""
+    tree = ast.parse(text)
+    return lint_d2(rel, tree) + lint_d3(rel, tree, donating=donating)
+
+
+def run_source(root: str | None = None) -> list[Finding]:
+    """D2+D3 over the repo (source_lint.iter_repo_sources — one shared
+    walk contract for every rule family)."""
+    findings: list[Finding] = []
+    for rel, text in iter_repo_sources(root):
+        try:
+            findings += lint_text(rel, text)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "D2", "source", "error",
+                f"unparseable source: {e}", rel))
+    return findings

@@ -1,0 +1,357 @@
+"""Compiled-HLO audit: extend the static audit past the jaxpr into what
+the backend compiler actually emitted.
+
+The jaxpr rules (R1-R6) are backend-independent; R1's miscompile class is
+ultimately a property of what the TPU *compiler* emits (ROADMAP tunnel
+checklist item 8).  This module is the backend-portable half of that
+item: it compiles the warmed chunk runners via
+``jit(...).lower(...).compile().as_text()`` on whatever backend is
+visible and audits the OPTIMIZED module — on CPU today; the on-chip run
+is a backend flag flip, not new code.  Parsing follows the conventions of
+``scripts/kernel_census.py``'s repaired HLO parser (comment stripping,
+greedy tuple-typed headers) — the census import is reused for the
+op-count cross-check.
+
+Checks (rule ID ``HLO``):
+
+* **Scatter instruction class** — every ``scatter`` instruction that
+  SURVIVES optimization is classified by its indices operand (the
+  ``classify_write`` convention): a single-update scatter is the
+  miscompile class and can never be waived.  XLA CPU expands most
+  scatters into sort/while forms (0 surviving instructions is normal
+  and recorded); on TPU the instructions survive and this check is the
+  round-5 certification, re-verified per build.
+* **Scatter site provenance** — expansion keeps jax's ``op_name``/
+  ``source_file`` metadata, so every scatter-derived instruction in the
+  compiled module is traced back to its source file, which must be an
+  ``R1_WAIVERS``-certified file: a scatter from any other file reached
+  the compiled program without the jaxpr audit seeing it (or a new site
+  rode an existing waiver) — works whether or not the backend expanded
+  the op.
+* **Digest-only small root** (sharded runner) — the ENTRY computation's
+  result tuple holds exactly ONE small output, the ``[DIGEST_WIDTH]``
+  int32 digest; every other output is fleet-sized (leading dim = padded
+  batch).  R5 proved this on the jaxpr; this proves the *executable*
+  kept it (a backend pass that materialized an extra small live-out
+  would widen the per-chunk host transfer).
+* **Alias survival** (the compiled half of the D1 donation rule) — the
+  executable's ``input_output_alias`` map still carries every donated
+  state leaf: donation requested at trace time but dropped by the
+  compiler would silently double the fleet's memory footprint.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+import numpy as np
+
+from .source_lint import Finding
+
+#: Files allowed to contribute scatter-primitive-derived instructions to
+#: a compiled module (path suffix -> justification).  The R1_WAIVERS
+#: engine files (certified vector sites) are added automatically by
+#: audit_hlo; entries here cover the STATIC-index class — scatters whose
+#: indices are constants/iota, which the jaxpr R1 rule classifies
+#: "static" and passes, but whose op_name metadata still says scatter in
+#: the compiled text.
+HLO_STATIC_SCATTER_FILES = {
+    "telemetry/plane.py":
+        "metrics-plane one-hot adds and the flight-recorder ring write: "
+        "constant/iota-derived indices (R1 'static' class — the jaxpr "
+        "audit classifies them, tests pin the decode against the "
+        "oracle); not the traced-index miscompile class.",
+}
+
+#: Optimized-module scatter instruction: "%name = TYPE scatter(".
+_SCATTER_INSTR_RE = re.compile(r"=\s[^=]*?\sscatter\(")
+_OPERAND_TYPE_RE = re.compile(r"[a-z][a-z0-9]*\[([\d,]*)\]")
+_IVD_RE = re.compile(r"index_vector_dim=(\d+)")
+
+
+def _scatter_indices_shape(line: str) -> tuple | None:
+    """The indices operand's shape from a scatter instruction line, or
+    ``None`` when the operand list cannot be read (fail-safe: the caller
+    flags unclassifiable scatters).  HLO scatter is VARIADIC —
+    ``scatter(op_1..op_N, indices, upd_1..upd_N)``, 2N+1 operands — so
+    the indices operand is the middle one; positional 3-operand parsing
+    would mistake a data operand for the indices on N > 1 and classify
+    from a fleet-sized shape."""
+    start = line.find("scatter(")
+    if start < 0:
+        return None
+    end = line.find(")", start)
+    if end < 0:
+        return None
+    shapes = _OPERAND_TYPE_RE.findall(line[start:end])
+    if not shapes or len(shapes) % 2 == 0:
+        return None
+    return _shape(shapes[len(shapes) // 2])
+#: jax metadata on any instruction derived from a scatter primitive.
+_SCATTER_META_RE = re.compile(
+    r'op_name="[^"]*/scatter[^"/]*"[^\n]*?source_file="([^"]+)"'
+    r'[^\n]*?source_line=(\d+)')
+_ALIAS_PAIR_RE = re.compile(r":\s*\(\d+,")
+_TYPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([\d,]*)\]")
+
+
+def _header_block(header: str, key: str) -> str | None:
+    """The brace-matched ``key={...}`` block from an HloModule header
+    (alias maps and layouts nest braces, so non-greedy regexes
+    under-read them)."""
+    start = header.find(key + "={")
+    if start < 0:
+        return None
+    i = header.index("{", start)
+    depth = 0
+    for j in range(i, len(header)):
+        if header[j] == "{":
+            depth += 1
+        elif header[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return header[i + 1:j]
+    return None
+
+
+def load_census():
+    """Import scripts/kernel_census.py (the repaired HLO parser) from the
+    package-relative scripts dir — the op-count conventions are shared,
+    not restated."""
+    from .source_lint import repo_root
+
+    sdir = os.path.join(repo_root(), "scripts")
+    if sdir not in sys.path:
+        sys.path.insert(0, sdir)
+    import kernel_census
+
+    return kernel_census
+
+
+def _shape(text: str) -> tuple:
+    return tuple(int(x) for x in text.split(",") if x) if text else ()
+
+
+def scatter_updates(indices_shape: tuple, index_vector_dim: int) -> int:
+    """Number of independent updates a scatter performs, from its indices
+    operand (the HLO-level twin of graph_lint.classify_write): every
+    indices dim except ``index_vector_dim`` enumerates updates."""
+    if not indices_shape:
+        return 1
+    dims = [d for i, d in enumerate(indices_shape)
+            if i != index_vector_dim]
+    return int(np.prod(dims)) if dims else 1
+
+
+def check_hlo_scatters(txt: str, flavor: str, allowed_files) -> tuple:
+    """The scatter-class + provenance checks on one optimized module.
+    ``allowed_files`` are the R1-certified source files (path suffixes);
+    returns ``(findings, stats)``."""
+    findings: list[Finding] = []
+    surviving = 0
+    scalar = 0
+    for line in txt.splitlines():
+        if not _SCATTER_INSTR_RE.search(line):
+            continue
+        surviving += 1
+        idx_shape = _scatter_indices_shape(line)
+        ivd_m = _IVD_RE.search(line)
+        if idx_shape is None or not ivd_m:
+            findings.append(Finding(
+                "HLO", flavor, "error",
+                "unparseable scatter instruction in optimized HLO — the "
+                "audit cannot classify it; update hlo_lint's parser for "
+                "this toolchain's text format (fail-safe: unclassified "
+                "is an error, like lost R1 provenance)", ""))
+            continue
+        n_upd = scatter_updates(idx_shape, int(ivd_m.group(1)))
+        if n_upd <= 1:
+            scalar += 1
+            findings.append(Finding(
+                "HLO", flavor, "error",
+                "single-update scatter instruction survived to the "
+                "optimized module — the TPU miscompile class at the "
+                "executable level (scripts/tpu_scatter_bug_repro.py); "
+                "the jaxpr R1 rule should have caught the site upstream",
+                ""))
+    sites = {}
+    for m in _SCATTER_META_RE.finditer(txt):
+        fname = m.group(1).replace("\\", "/")
+        sites.setdefault(fname, set()).add(int(m.group(2)))
+    for fname, lines in sorted(sites.items()):
+        if any(fname.endswith(ok) for ok in allowed_files):
+            continue
+        findings.append(Finding(
+            "HLO", flavor, "error",
+            f"compiled module contains scatter-derived instructions from "
+            f"uncertified file {fname} (lines {sorted(lines)[:4]}) — "
+            "every scatter site in a dispatched program must be an "
+            "R1_WAIVERS-certified site (fuzz + census + chip validation "
+            "behind it)", f"{fname}:{min(lines)}"))
+    stats = {
+        "scatter_instructions": surviving,
+        "scatter_scalar": scalar,
+        "scatter_site_files": sorted(sites),
+        "scatter_sites": sum(len(v) for v in sites.values()),
+    }
+    return findings, stats
+
+
+def check_hlo_root(txt: str, flavor: str, padded_b: int,
+                   digest_width: int) -> list[Finding]:
+    """The executable-level R5: exactly one small root output (the
+    ``[digest_width]`` int digest), everything else fleet-sized."""
+    findings: list[Finding] = []
+    header = txt.splitlines()[0] if txt else ""
+    layout = _header_block(header, "entry_computation_layout")
+    if layout is None or "->" not in layout:
+        return [Finding(
+            "HLO", flavor, "error",
+            "no entry_computation_layout in the optimized module header "
+            "— the digest-only root check cannot run (update hlo_lint "
+            "for this toolchain's header format)", "")]
+    outs = _TYPE_RE.findall(layout.split("->", 1)[1])
+    digests = [s for d, s in outs
+               if _shape(s) == (digest_width,) and d.startswith(("s", "u"))]
+    if len(digests) != 1:
+        findings.append(Finding(
+            "HLO", flavor, "error",
+            f"compiled sharded runner has {len(digests)} "
+            f"[{digest_width}]-int outputs (want exactly 1: the digest) "
+            "— the executable-level poll contract of "
+            "parallel/sharded.run_sharded", ""))
+    for dtype, shape_s in outs:
+        shape = _shape(shape_s)
+        if shape == (digest_width,) and dtype.startswith(("s", "u")):
+            continue
+        if not shape or shape[0] != padded_b:
+            findings.append(Finding(
+                "HLO", flavor, "error",
+                f"non-fleet-sized output {dtype}[{shape_s}] in the "
+                f"compiled root (leading dim != padded batch {padded_b}) "
+                "— an extra small live-out is another per-chunk host "
+                "transfer candidate the jaxpr R5 rule did not see", ""))
+    return findings
+
+
+def check_hlo_alias(txt: str, flavor: str,
+                    expected_donated: int) -> tuple[list[Finding], dict]:
+    """The compiled half of D1: the executable's input_output_alias map
+    must still pair every donated state leaf."""
+    header = txt.splitlines()[0] if txt else ""
+    block = _header_block(header, "input_output_alias")
+    pairs = len(_ALIAS_PAIR_RE.findall(block)) if block else 0
+    findings: list[Finding] = []
+    if pairs != expected_donated:
+        findings.append(Finding(
+            "HLO", flavor, "error",
+            f"executable input_output_alias carries {pairs} pairs vs "
+            f"{expected_donated} donated state leaves — donation "
+            "requested at trace time was dropped by the compiler "
+            "(every dropped pair is a fleet-leaf-sized copy per chunk)",
+            ""))
+    return findings, {"alias_pairs": pairs}
+
+
+# ---------------------------------------------------------------------------
+# The compiled matrix.
+# ---------------------------------------------------------------------------
+
+
+def audit_hlo() -> tuple[list[Finding], dict]:
+    """Compile the warmed micro-fleet chunk runners (both engines + the
+    dp-sharded digest runner) on the visible backend and run every check.
+
+    The shapes are the tests/fleet_shapes.py contract — the executables
+    tier-1 already compiles — so with a warm persistent compile cache
+    this costs seconds; the first-ever run on a cold container pays the
+    compiles once into the cache.  On a TPU backend the same three
+    compiles audit the real chip lowering (tunnel item 8's flag flip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.types import SimParams
+    from ..parallel import mesh as mesh_ops
+    from ..parallel import sharded
+    from ..sim import parallel_sim as PE
+    from ..sim import simulator as S
+    from ..utils import xops
+    from . import graph_lint as GL
+    from .source_lint import repo_root
+
+    tdir = os.path.join(repo_root(), "tests")
+    if tdir not in sys.path:
+        sys.path.insert(0, tdir)
+    from fleet_shapes import FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, \
+        FLEET_SER_KW
+
+    allowed = tuple(GL.R1_WAIVERS) + tuple(HLO_STATIC_SCATTER_FILES)
+    findings: list[Finding] = []
+    stats: dict = {}
+
+    def audit_text(flavor, txt, donated, padded_b=None):
+        f, st = check_hlo_scatters(txt, flavor, allowed)
+        findings.extend(f)
+        f2, st2 = check_hlo_alias(txt, flavor, donated)
+        findings.extend(f2)
+        st.update(st2)
+        if padded_b is not None:
+            findings.extend(check_hlo_root(
+                txt, flavor, padded_b, GL.DIGEST_WIDTH))
+        cns = load_census().hlo_counts(txt)
+        st["top_fusions"] = cns["top_fusions"]
+        st["backend"] = jax.default_backend()
+        stats[flavor] = st
+
+    # Serial chunk runner (the digest flavor tier-1 streams).
+    p = xops.resolve_params(
+        SimParams(max_clock=500, **FLEET_SER_KW, **GL.TPU_FORMS))
+    st = S.dedupe_buffers(S.init_batch(
+        p, np.arange(FLEET_B, dtype=np.uint32)))
+    inner = S._compiled_digest_run(p.structural(), FLEET_CHUNK, True)
+    txt = inner.lower(jnp.asarray(p.delay_table()),
+                      jnp.asarray(p.duration_table()), st) \
+        .compile().as_text()
+    n_state = len(jax.tree_util.tree_leaves(st))
+    audit_text("serial/chunk", txt, donated=n_state)
+
+    # Lane chunk runner.
+    p_l = xops.resolve_params(
+        SimParams(max_clock=500, **FLEET_LANE_KW, **GL.TPU_FORMS))
+    st_l = S.dedupe_buffers(PE.init_batch(
+        p_l, np.arange(FLEET_B, dtype=np.uint32)))
+    inner = PE._compiled_digest_run(p_l.structural(), FLEET_CHUNK, True)
+    txt = inner.lower(jnp.asarray(p_l.delay_table()),
+                      jnp.asarray(p_l.duration_table()),
+                      jnp.asarray(PE.d_min_of(p_l), jnp.int32), st_l) \
+        .compile().as_text()
+    audit_text("lane/chunk", txt, donated=len(jax.tree_util.tree_leaves(st_l)))
+
+    # The dp-sharded fleet runner: + the digest-only-root check.
+    if len(jax.devices()) < 2:
+        findings.append(Finding(
+            "HLO", "sharded/chunk", "error",
+            "cannot HLO-audit the sharded runner: <2 devices (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 before "
+            "importing jax; scripts/graph_audit.py does)", ""))
+        return findings, stats
+    import dataclasses as dc
+
+    mesh = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    st_sh = S.init_batch(p, sharded.fleet_seeds(0, FLEET_B))
+    st_sh, _ = sharded.pad_to_multiple(p, st_sh, mesh.size)
+    padded_b = sharded.batch_size(st_sh)
+    st_sh = mesh_ops.shard_batch(mesh, S.dedupe_buffers(st_sh))
+    key_p = dc.replace(p, max_clock=0, drop_prob=0.0)
+    run = sharded._cached_sharded_run_fn(key_p, mesh, FLEET_CHUNK, S,
+                                         "shard_map")
+    txt = run.lower(st_sh).compile().as_text()
+    # Under shard_map the optimized module IS the per-shard program
+    # (scripts/kernel_census.py census_sharded documents the same), so
+    # "fleet-sized" at the executable level means the LOCAL batch rows.
+    audit_text("sharded/chunk", txt,
+               donated=len(jax.tree_util.tree_leaves(st_sh)),
+               padded_b=padded_b // mesh.size)
+    return findings, stats

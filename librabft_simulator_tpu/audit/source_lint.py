@@ -130,17 +130,22 @@ STEP_TRACER_ARGS = {
 
 def _s2_in_scope(rel: str) -> bool:
     """Hot-loop modules: the engines, the parallel runtime, in-graph
-    telemetry, core protocol, kernels, utils.  Post-run decode modules are
-    host-side by design (analysis/, report.py, checkpoint.py, byzantine
-    referees, main.py, oracle/, realnode/).  telemetry/ledger.py is
-    in scope BY REGISTRATION, not waiver: the runtime ledger wraps the
-    fleet loop's dispatch/poll from the host side and must itself contain
-    zero device syncs — this rule proves that on every lint run."""
+    telemetry, core protocol, kernels, utils — and since round 16 the
+    serve/ resident loop and the distributed/ runtime (both live INSIDE
+    the dispatch pipeline: an unsanctioned sync there stalls every
+    chunk, which is exactly the modules the round-10 scope predated).
+    Post-run decode modules are host-side by design (analysis/,
+    report.py, checkpoint.py, byzantine referees, main.py, oracle/,
+    realnode/).  telemetry/ledger.py is in scope BY REGISTRATION, not
+    waiver: the runtime ledger wraps the fleet loop's dispatch/poll from
+    the host side and must itself contain zero device syncs — this rule
+    proves that on every lint run."""
     if rel in ("sim/simulator.py", "sim/parallel_sim.py",
                "telemetry/plane.py", "telemetry/stream.py",
                "telemetry/ledger.py"):
         return True
-    return rel.startswith(("core/", "parallel/", "ops/", "utils/"))
+    return rel.startswith(("core/", "parallel/", "ops/", "utils/",
+                           "serve/", "distributed/"))
 
 
 #: (package-relative file, enclosing function) -> justification.  Every
@@ -161,6 +166,32 @@ SANCTIONED_SYNCS = {
         "construction.",
     ("sim/parallel_sim.py", "run_to_completion"):
         "single-chip host completion loop (tests/CLI).",
+    # --- serve/ (round 16: the resident fleet loop joined S2 scope) ----
+    ("serve/service.py", "_egress"):
+        "digest-TRIGGERED only (never steady-state): one [slots] halted "
+        "fetch to identify finished slots, then one gather per leaf over "
+        "the k finished rows — between chunks, outside the double-"
+        "buffered dispatch (tests/test_serve.py pins the poll path "
+        "stays one [13] digest per chunk).",
+    ("serve/service.py", "_admit"):
+        "admission-time fetch of k freshly-initialised scenario rows "
+        "into the host-side donor — per admission wave, not per chunk; "
+        "the resident executable itself is never touched.",
+    ("serve/service.py", "save"):
+        "preemption checkpoint: the whole resident fleet lands on host "
+        "by design, once, at an eviction boundary.",
+    # --- distributed/ (round 16) ---------------------------------------
+    ("distributed/egress.py", "local_rows_at"):
+        "per-host egress landing: O(k) device-side row gathers over the "
+        "finished slots only — per egress event, outside the chunk "
+        "loop, never the whole local shard.",
+    ("distributed/workers.py", "fleet_run"):
+        "one-time host-staging of the init fleet before placement (the "
+        "multi-process device_put contract) — before the chunk loop "
+        "starts.",
+    ("distributed/workers.py", "fleet_phase"):
+        "one-time host-staging of the init fleet (same contract as "
+        "fleet_run) for the resize-under-fire checkpoint phase.",
 }
 
 # ---------------------------------------------------------------------------
@@ -232,6 +263,17 @@ def _names_in(node) -> set:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
 
+def enclosing_functions(funcs: list, lineno: int) -> list[str]:
+    """All enclosing function names for a line, outermost first (or
+    ``["<module>"]``).  Shared by every registry-keyed rule (S2, D2, C1):
+    a sanction on a host function must cover its nested helpers, so
+    lookups check the whole chain — the innermost-only form silently
+    false-positives the moment a sanctioned body grows a closure."""
+    names = [fn.name for fn in funcs
+             if fn.node.lineno <= lineno <= (fn.node.end_lineno or 0)]
+    return names or ["<module>"]
+
+
 # ---------------------------------------------------------------------------
 # Rules.
 # ---------------------------------------------------------------------------
@@ -291,13 +333,6 @@ def lint_s2(rel: str, tree: ast.Module) -> list[Finding]:
     findings = []
     funcs = _functions(tree)
 
-    def enclosing(lineno) -> str:
-        best = "<module>"
-        for fn in funcs:
-            if fn.node.lineno <= lineno <= (fn.node.end_lineno or 0):
-                best = fn.name  # innermost wins (functions walked outer-in)
-        return best
-
     for node in ast.walk(tree):
         # Both spellings: jax.device_get / x.block_until_ready
         # (Attribute) AND `from jax import device_get; device_get(...)`
@@ -310,8 +345,9 @@ def lint_s2(rel: str, tree: ast.Module) -> list[Finding]:
             continue
         if name not in ("device_get", "block_until_ready"):
             continue
-        func = enclosing(node.lineno)
-        if (rel, func) in SANCTIONED_SYNCS:
+        chain = enclosing_functions(funcs, node.lineno)
+        func = chain[-1]
+        if any((rel, fname) in SANCTIONED_SYNCS for fname in chain):
             continue
         findings.append(Finding(
             "S2", "source", "error",
@@ -447,6 +483,28 @@ def repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+def iter_repo_sources(root: str | None = None):
+    """Yield ``(rel, text)`` for every lintable .py file — THE one repo
+    walk contract, shared by the S/D/C rule runners (source_lint,
+    donation_lint, concurrency_lint) so their scopes can never drift:
+    package files get package-relative paths ('sim/simulator.py'),
+    everything else repo-relative ('scripts/x.py')."""
+    root = root or repo_root()
+    skip_dirs = {"tests", "__pycache__", "native", ".git", ".claude",
+                 "related"}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+        for name in sorted(filenames):
+            if not name.endswith(".py") or name == "__graft_entry__.py":
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel.startswith(PACKAGE + "/"):
+                rel = rel[len(PACKAGE) + 1:]
+            with open(path) as f:
+                yield rel, f.read()
+
+
 def lint_text(rel: str, text: str) -> list[Finding]:
     """Lint one file's source (S1-S3).  ``rel`` is the path the scope
     rules see: package files are package-relative ('sim/simulator.py'),
@@ -460,24 +518,13 @@ def run(root: str | None = None) -> list[Finding]:
     """Lint the whole repo; returns all findings (S1-S4)."""
     root = root or repo_root()
     findings: list[Finding] = []
-    skip_dirs = {"tests", "__pycache__", "native", ".git", ".claude",
-                 "related"}
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d not in skip_dirs]
-        for name in sorted(filenames):
-            if not name.endswith(".py") or name == "__graft_entry__.py":
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            if rel.startswith(PACKAGE + "/"):
-                rel = rel[len(PACKAGE) + 1:]
-            with open(path) as f:
-                try:
-                    findings += lint_text(rel, f.read())
-                except SyntaxError as e:
-                    findings.append(Finding(
-                        "S1", "source", "error",
-                        f"unparseable source: {e}", rel))
+    for rel, text in iter_repo_sources(root):
+        try:
+            findings += lint_text(rel, text)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "S1", "source", "error",
+                f"unparseable source: {e}", rel))
     findings += lint_s4(root)
     try:
         in_sync = knobs_mod.readme_in_sync(

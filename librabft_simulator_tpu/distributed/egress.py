@@ -252,14 +252,45 @@ def merge_shards(d: str, out_path: str | None = None) -> str:
     covered: list[tuple[int, int]] = []
     pieces: dict[str, list[tuple[int, np.ndarray]]] = {}
     for side in sidecars:
-        data = np.load(_shard_paths(d, side["process_id"])[0])
+        pid = side["process_id"]
+        bin_path = _shard_paths(d, pid)[0]
+        # Corruption is refused LOUDLY with a recovery hint, never a
+        # zipfile traceback: a failover restart reads shards written by
+        # processes that may have been SIGKILLed mid-write, so a
+        # truncated archive is an expected input here, not a bug.
+        try:
+            data = np.load(bin_path)
+            arrays = {key: data[key] for key in data.files}
+        except Exception as e:  # noqa: BLE001 — zipfile/OSError/pickle
+            raise ValueError(
+                f"{bin_path}: unreadable checkpoint shard "
+                f"({type(e).__name__}: {e}) — the writer was likely "
+                "killed mid-write; recover the shard from the owning "
+                "host or re-checkpoint before resuming") from None
         for j, (s, e) in enumerate(side["spans"]):
             covered.append((s, e))
-            for key in data.files:
+            n_keys = 0
+            for key, arr in arrays.items():
                 if not key.startswith(f"b{j}:"):
                     continue
+                n_keys += 1
+                if int(arr.shape[0]) != e - s:
+                    # A payload/sidecar split-brain (partial rewrite,
+                    # mixed-run directory) would otherwise concatenate
+                    # into a silently-corrupt fleet.
+                    raise ValueError(
+                        f"{bin_path}: block b{j}:{key.split(':', 1)[1]} "
+                        f"holds {int(arr.shape[0])} rows but the sidecar "
+                        f"span [{s}, {e}) promises {e - s} — shard "
+                        "payload and sidecar disagree (mixed checkpoint "
+                        "generations in one dir?); re-checkpoint")
                 pieces.setdefault(key.split(":", 1)[1], []).append(
-                    (s, data[key]))
+                    (s, arr))
+            if n_keys == 0:
+                raise ValueError(
+                    f"{bin_path}: sidecar promises span [{s}, {e}) as "
+                    f"block b{j} but the archive has no b{j}:* arrays — "
+                    "shard payload and sidecar disagree; re-checkpoint")
     covered.sort()
     pos = 0
     for s, e in covered:

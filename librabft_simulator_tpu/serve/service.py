@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import threading
 import time
 from collections import deque
 
@@ -145,6 +146,14 @@ class ResidentFleet:
         self._active: dict[int, ScenarioRequest] = {}
         self.requests: dict[str, ScenarioRequest] = {}
         self.results: dict[str, dict] = {}
+        # The admission-queue lock: submit()/poll() are the service's
+        # operator surface and may run on a different thread than the
+        # serve() pump (an NDJSON front-end feeding a resident loop), so
+        # every MUTATION of the queue-facing state (_pending, requests,
+        # results) holds this RLock — the C2 lock-discipline rule
+        # (audit/concurrency_lint.py) pins the registry statically.
+        # _active/slot bookkeeping stays serve-loop-private.
+        self._qlock = threading.RLock()
         self.chunks_polled = 0
         # Global dispatch counter: every dispatched chunk gets polled by
         # the end of a serve() call, so this equals chunks_polled between
@@ -175,19 +184,21 @@ class ResidentFleet:
         """Queue one scenario; returns its request id."""
         if isinstance(spec, dict):
             spec = sc.ScenarioSpec.from_dict(spec)
-        if request_id is not None:
-            rid = request_id
-        else:
-            # Skip past restored ids: a resumed service's counter restarts,
-            # and a collision would silently overwrite the old result.
-            rid = f"r{next(self._ids)}"
-            while rid in self.requests:
+        with self._qlock:
+            if request_id is not None:
+                rid = request_id
+            else:
+                # Skip past restored ids: a resumed service's counter
+                # restarts, and a collision would silently overwrite the
+                # old result.
                 rid = f"r{next(self._ids)}"
-        if rid in self.requests:
-            raise ValueError(f"duplicate request id {rid!r}")
-        req = ScenarioRequest(rid, spec, submitted_t=self._now())
-        self._pending.append(req)
-        self.requests[rid] = req
+                while rid in self.requests:
+                    rid = f"r{next(self._ids)}"
+            if rid in self.requests:
+                raise ValueError(f"duplicate request id {rid!r}")
+            req = ScenarioRequest(rid, spec, submitted_t=self._now())
+            self._pending.append(req)
+            self.requests[rid] = req
         self._emit_request(req, "submitted")
         return rid
 
@@ -368,7 +379,9 @@ class ResidentFleet:
                 else:
                     row = jax.tree.map(lambda x, jj=j: x[jj], rows)
                 if row is not None:
-                    self.results[req.request_id] = self._result_of(req, row)
+                    res = self._result_of(req, row)
+                    with self._qlock:
+                        self.results[req.request_id] = res
                 self._emit_request(
                     req, "egressed",
                     latency_s=round(req.egressed_t - req.submitted_t, 6),
@@ -418,15 +431,16 @@ class ResidentFleet:
         bounded [B]-sized H2D copy per admission wave for exactly the
         per-config compile storm this subsystem exists to kill."""
         free = [s for s in range(self.slots) if s not in self._active]
-        k = min(len(free), len(self._pending))
-        if k == 0:
-            return st
+        with self._qlock:
+            k = min(len(free), len(self._pending))
+            if k == 0:
+                return st
+            taken = [self._pending.popleft() for _ in range(k)]
         with self._lg.span(tledger.ADMIT, run=self._rid, requests=k):
             mask = np.zeros((self.slots,), bool)
             donor = None
             admitted = []
-            for slot in free[:k]:
-                req = self._pending.popleft()
+            for slot, req in zip(free[:k], taken):
                 req.slot = slot
                 row_st = jax.tree.map(
                     lambda x: np.asarray(jax.device_get(x)),
@@ -484,16 +498,20 @@ class ResidentFleet:
             return {"request_id": r.request_id, "spec": r.spec.to_dict(),
                     "slot": r.slot, "status": r.status}
 
-        side = {
-            "serve_version": 1,
-            "slots": self.slots,
-            "chunk": self.chunk,
-            "chunks_polled": self.chunks_polled,
-            "active": {str(s): req_dict(r)
-                       for s, r in self._active.items()},
-            "pending": [req_dict(r) for r in self._pending],
-            "results": self.results,
-        }
+        # Snapshot the queue-facing state under the admission lock: an
+        # operator thread may be submit()ing while eviction saves, and an
+        # unlocked deque iteration raises (or the sidecar lands torn).
+        with self._qlock:
+            side = {
+                "serve_version": 1,
+                "slots": self.slots,
+                "chunk": self.chunk,
+                "chunks_polled": self.chunks_polled,
+                "active": {str(s): req_dict(r)
+                           for s, r in self._active.items()},
+                "pending": [req_dict(r) for r in self._pending],
+                "results": dict(self.results),
+            }
         with open(path + ".serve.json", "w") as f:
             json.dump(side, f, indent=1)
 

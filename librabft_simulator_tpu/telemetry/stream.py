@@ -47,6 +47,7 @@ so reordering slots can never silently corrupt decoded reports.
 from __future__ import annotations
 
 import json
+import threading
 import time
 
 import jax
@@ -240,6 +241,12 @@ class TimelineRecorder:
         self.rows = []
         self._owns_out = isinstance(out, str)
         self._out = open(out, "w") if self._owns_out else out
+        # Row writes serialize under this lock: the resident fleet
+        # service emits request rows from operator threads (submit())
+        # while the serve thread streams digests onto the SAME file —
+        # interleaved buffered writes would land a corrupt NON-final
+        # line, which load_ndjson refuses loudly (by design).
+        self._wlock = threading.Lock()
         self._t0 = self._last_t = time.perf_counter()
         self._last_events = 0
         header = {
@@ -256,8 +263,9 @@ class TimelineRecorder:
 
     def _emit(self, obj) -> None:
         if self._out is not None:
-            self._out.write(json.dumps(obj) + "\n")
-            self._out.flush()
+            with self._wlock:
+                self._out.write(json.dumps(obj) + "\n")
+                self._out.flush()
 
     def emit(self, obj: dict) -> None:
         """Append one extra NDJSON line to the stream (no-op without an

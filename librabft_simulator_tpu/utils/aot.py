@@ -353,16 +353,47 @@ def save(skey: str, compiled, compile_s: float | None = None,
         return None
 
 
+def _flock_bounded(fobj, timeout_s: float = 30.0) -> None:
+    """Exclusive flock with a hard deadline: spin ``LOCK_NB`` until the
+    lock lands or ``timeout_s`` expires (``TimeoutError``).  A plain
+    blocking ``LOCK_EX`` would let one crashed/wedged writer park every
+    later build forever — the C1 concurrency rule
+    (audit/concurrency_lint.py) pins this as the only flock form.
+    Only contention errnos retry; a real flock failure (ENOTSUP on a
+    filesystem without flock, EBADF) re-raises immediately instead of
+    burning the deadline on a misdiagnosis."""
+    import errno
+    import fcntl
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            fcntl.flock(fobj, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return
+        except OSError as e:
+            if e.errno not in (errno.EAGAIN, errno.EWOULDBLOCK,
+                               errno.EACCES):
+                raise
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"manifest lock not acquired within {timeout_s}s — "
+                    "another writer is wedged holding "
+                    f"{getattr(fobj, 'name', '?')}; remove the stale "
+                    ".manifest.lock holder and rebuild") from None
+            time.sleep(0.05)
+
+
 def _refresh_manifest() -> None:
     """Rebuild ``manifest.json`` from the sidecars, serialized across
-    concurrent writers with an fcntl lock (warm_cache children and bench
-    rungs may export into one store back-to-back)."""
+    concurrent writers with a DEADLINE-bounded fcntl lock (warm_cache
+    children and bench rungs may export into one store back-to-back; a
+    wedged holder times out loudly instead of hanging the build)."""
     import fcntl
 
     d = store_dir()
     lock_path = os.path.join(d, ".manifest.lock")
     with open(lock_path, "w") as lk:
-        fcntl.flock(lk, fcntl.LOCK_EX)
+        _flock_bounded(lk)
         try:
             entries = []
             for name in sorted(os.listdir(d)):

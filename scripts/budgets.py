@@ -53,6 +53,10 @@ toolchains.
 * ``tier1_min_dots`` 39     — the seed suite's dot count at the 870 s
   timeout; PR baselines since run 49-59 (see CHANGES.md).
 
+``DONATION`` (round 16) pins the donation/aliasing verifier's expected
+per-flavor donated-leaf counts (audit/donation_lint.py rule D1) — exact
+equalities, not ceilings; provenance inline below.
+
 Usage:
     python scripts/budgets.py            # print the table
     python scripts/budgets.py --sh       # shell-eval'able defaults
@@ -71,6 +75,32 @@ BUDGETS = {
     "census_k16": 1090,
     "census_scenario": 1140,
     "tier1_min_dots": 39,
+}
+
+#: Expected DONATED input-leaf count per runner flavor — the D1 pin
+#: (audit/donation_lint.py reads this; round-16 measurement).  A donation
+#: map is a leaf-count property of (donate_argnums x pytree structure),
+#: independent of shapes, so these are exact equalities, not budgets:
+#: any drift (a state leaf added/removed, a donate_argnums change, a
+#: jit that silently stopped donating) is a gated diff, reviewed next to
+#: the dedupe_buffers call-site audit — never a silent rebaseline.
+#: Provenance: engine states flatten to 110 leaves (PSimState 108); the
+#: serial/lane runners donate exactly the state argument (tables and the
+#: lane lookahead scalar are host-reused), the sharded runner's ONLY
+#: input is the donated state, install_rows donates the resident state
+#: but never the admission mask/donor, and the checkify sanitizer build
+#: donates NOTHING (callers hand it externally-held states with no
+#: dedupe obligation).
+DONATION = {
+    "serial/run": 110,
+    "serial/digest": 110,
+    "serial/telemetry": 110,
+    "serial/scenario": 110,
+    "lane/digest": 108,
+    "sharded/digest": 110,
+    "sharded/scenario": 110,
+    "serve/install": 110,
+    "sanitize/serial": 0,
 }
 
 #: The shell variable each budget materializes as (ci_tier1.sh contract).

@@ -36,12 +36,16 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/ \
     exit 1
 fi
 
-echo "=== static graph + source audit (audit/: jaxpr rules R1-R6, source lint S1-S4) ==="
-# Fail fast: audit traces are minutes of pure Python, cheaper than any
-# XLA compile below.  Emits the machine-readable artifact either way.
+echo "=== static audit v2, fast families (jaxpr R1-R6, source S1-S4, donation D1-D3, concurrency C1-C3) ==="
+# Fail fast: these passes are traced or AST work — no XLA compile —
+# so they fit the 600 s cap even on a virgin container.  The
+# compiled-HLO family runs as its own staged leg AFTER the AOT
+# prebuild below (which populates the persistent compile cache with
+# exactly the chunk executables the HLO pass compiles; cold it would
+# blow this stage's budget).  The artifact is always written.
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
-    --assert-clean --out GRAPH_AUDIT_r11.json; then
-    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r11.json)" >&2
+    --assert-clean --no-hlo --out GRAPH_AUDIT_r16.json; then
+    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r16.json)" >&2
     exit 1
 fi
 
@@ -75,6 +79,19 @@ if [ "${AOT_PREBUILD:-1}" != "0" ]; then
         fi
     fi
     python -m librabft_simulator_tpu.utils.aot --list || true
+fi
+
+echo "=== static audit v2, compiled-HLO leg (scatter class + provenance, digest-only root, alias survival) ==="
+# The one audit family that invokes XLA, staged here so its three
+# fleet-shape chunk compiles ride the persistent cache the prebuild
+# just populated (seconds warm; the first-ever container run pays them
+# once).  --engines "" --no-sharded skips the jaxpr matrix the fast
+# stage already passed; the HLO artifact lands beside the main one.
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
+    --assert-clean --engines "" --no-sharded --no-source --no-donation \
+    --no-concurrency --out GRAPH_AUDIT_r16_hlo.json; then
+    echo "FAIL: compiled-HLO audit not clean (see GRAPH_AUDIT_r16_hlo.json)" >&2
+    exit 1
 fi
 
 echo "=== tier-1 test suite ==="

@@ -99,6 +99,7 @@ def main(argv=None) -> int:
             results = svc.drain(**kw)
             for rid, _ in requests:  # submission order, not egress order
                 out_f.write(json.dumps(results[rid]) + "\n")
+                out_f.flush()  # per-row: a timeout kill keeps landed rows
             occ = svc.fleet.occupancy()
             print(f"# served {len(results)} requests on {occ['slots']} "
                   f"slots, {svc.fleet.chunks_polled} chunks",

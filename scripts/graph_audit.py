@@ -13,11 +13,30 @@ Passes (librabft_simulator_tpu/audit/):
 2. **Source lint** — AST rules S1-S4 (host libs in traced code,
    unsanctioned host syncs, unregistered env knobs, duplicated budget
    literals) + the README knob-table sync check (source_lint.py).
-3. **Sanitizer smoke** (``--sanitize``) — compiles and runs the
+3. **Donation & aliasing verifier** — D-rules (donation_lint.py): the
+   per-flavor donation map read from each runner's STAGED lowering
+   (``.lower()`` only — no XLA compile) and pinned against
+   scripts/budgets.py DONATION, plus the AST rules D2
+   (dedupe-before-placement: the PR-9 bare-device_put-into-donating-
+   runner segfault class) and D3 (host use-after-donate).
+4. **Host-concurrency lint** — C-rules (concurrency_lint.py, pure AST):
+   C1 every cross-process wait bounded (the wedged-gloo-collective hang
+   class), C2 lock discipline over registered shared state, C3 NDJSON
+   rows flushed per write.
+5. **Compiled-HLO audit** (``hlo_lint.py``; skip with ``--no-hlo``) —
+   compiles the warmed micro-fleet chunk runners on the visible backend
+   and audits the OPTIMIZED module: scatter instruction class + site
+   provenance (the R1-waived sites must be the only scatter sources),
+   the digest-only small root at the executable level, and donation
+   alias survival.  The only pass that invokes XLA; on a warm
+   persistent cache it costs seconds (tunnel item 8: on-chip = flag
+   flip).
+6. **Sanitizer smoke** (``--sanitize``) — compiles and runs the
    checkify-instrumented chunk of both engines at the warmed micro fleet
-   shapes; any tripped state invariant fails.  Off by default (it
-   compiles); scripts/warm_cache.py runs it to pre-warm the debug
-   executables, and tests/test_audit.py smokes it in tier-1.
+   shapes (plus the scenario-plane flavor); any tripped state invariant
+   fails.  Off by default (it compiles); scripts/warm_cache.py runs it
+   to pre-warm the debug executables, and tests/test_audit.py smokes it
+   in tier-1.
 
 Output: a GRAPH_AUDIT artifact (rule -> status -> offending eqn/source
 site) via ``--out``; ``--assert-clean`` exits nonzero on any error-grade
@@ -26,7 +45,8 @@ finding (waived findings are recorded but pass).
 Usage:
     JAX_PLATFORMS=cpu python scripts/graph_audit.py --assert-clean
     python scripts/graph_audit.py --shape micro --sanitize
-    python scripts/graph_audit.py --out GRAPH_AUDIT_r11.json
+    python scripts/graph_audit.py --no-hlo --no-donation   # jaxpr+AST only
+    python scripts/graph_audit.py --out GRAPH_AUDIT_r16.json
 """
 
 from __future__ import annotations
@@ -61,11 +81,13 @@ def run_sanitize_smoke() -> list:
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))), "tests"))
     from fleet_shapes import FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, \
-        FLEET_SER_KW
+        FLEET_SCENARIO_SER_KW, FLEET_SER_KW
 
     findings = []
     for name, eng, kw in (("serial", simulator, FLEET_SER_KW),
-                          ("parallel", parallel_sim, FLEET_LANE_KW)):
+                          ("parallel", parallel_sim, FLEET_LANE_KW),
+                          ("serial-scenario", simulator,
+                           FLEET_SCENARIO_SER_KW)):
         p = SimParams(max_clock=500, **kw)
         st = eng.init_batch(p, np.arange(FLEET_B, dtype=np.uint32))
         try:
@@ -92,6 +114,16 @@ def main() -> int:
                     help="skip the sharded-runner rules (R5, R6/mp)")
     ap.add_argument("--no-source", action="store_true",
                     help="skip the AST source lint")
+    ap.add_argument("--no-donation", action="store_true",
+                    help="skip the donation/aliasing verifier (D-rules: "
+                         "staged lowerings + the dedupe/use-after-donate "
+                         "AST rules)")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip the host-concurrency lint (C-rules)")
+    ap.add_argument("--no-hlo", action="store_true",
+                    help="skip the compiled-HLO audit (the one pass that "
+                         "invokes XLA; seconds on a warm persistent "
+                         "cache, minutes cold)")
     ap.add_argument("--sanitize", action="store_true",
                     help="also compile+run the checkify sanitizer smoke "
                          "at the micro fleet shapes")
@@ -114,6 +146,33 @@ def main() -> int:
         src = source_lint.run()
         out["findings"] += [f.to_json() for f in src]
         out["source_findings"] = len(src)
+    if not args.no_donation:
+        from librabft_simulator_tpu.audit import donation_lint
+
+        t1 = time.time()
+        # Always the micro shapes: a donation map is a LEAF-COUNT
+        # property (donate_argnums x pytree structure), independent of
+        # n_nodes/capacities — micro keeps the staging matrix cheap and
+        # the budgets.py DONATION pins shape-free.
+        df, dstats = donation_lint.audit_donation(shape="micro")
+        df += donation_lint.run_source()
+        out["findings"] += [f.to_json() for f in df]
+        out["donation"] = {"flavors": dstats,
+                           "seconds": round(time.time() - t1, 1)}
+    if not args.no_concurrency:
+        from librabft_simulator_tpu.audit import concurrency_lint
+
+        cf = concurrency_lint.run()
+        out["findings"] += [f.to_json() for f in cf]
+        out["concurrency_findings"] = len(cf)
+    if not args.no_hlo:
+        from librabft_simulator_tpu.audit import hlo_lint
+
+        t1 = time.time()
+        hf, hstats = hlo_lint.audit_hlo()
+        out["findings"] += [f.to_json() for f in hf]
+        out["hlo"] = {"flavors": hstats,
+                      "seconds": round(time.time() - t1, 1)}
     if args.sanitize:
         san = run_sanitize_smoke()
         out["findings"] += [f.to_json() for f in san]

@@ -116,6 +116,12 @@ SHAPES += [
 SANITIZE_SHAPES = [
     ("serial", FLEET_SER_KW, FLEET_B, FLEET_CHUNK),
     ("parallel", FLEET_LANE_KW, FLEET_B, FLEET_CHUNK),
+    # The scenario-plane sanitizer build (round 16): LIBRABFT_CHECKIFY
+    # on a SimParams.scenario=True fleet is its own executable (the
+    # traced sc_delay reads + commit select under the checkify error
+    # plumbing); tests/test_audit.py pins it bit-identical to the
+    # unchecked scenario engine at exactly this shape.
+    ("serial", FLEET_SCENARIO_SER_KW, FLEET_B, FLEET_CHUNK),
 ]
 
 # (engine, SimParams kwargs, batch, chunk, dp): the sharded twins —

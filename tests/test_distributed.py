@@ -223,7 +223,9 @@ def test_shard_save_merge_roundtrip(tmp_path):
     side_path = os.path.join(d, "shard-0.json")
     with open(side_path) as f:
         side = json.load(f)
-    side["spans"] = [side["spans"][0][:1] + [2]]  # cover only [0, 2)
+    # Offset span, payload-consistent (5 rows either way): a pure
+    # coverage gap — rows [0, 1) belong to nobody.
+    side["spans"] = [[1, 6]]
     with open(side_path, "w") as f:
         json.dump(side, f)
     with pytest.raises(ValueError, match="covers"):
@@ -237,6 +239,72 @@ def test_shard_save_merge_roundtrip(tmp_path):
         egress.merge_shards(d)
     with pytest.raises(FileNotFoundError):
         egress.merge_shards(str(tmp_path / "empty.d"))
+
+
+def test_merge_shards_corruption_refused(tmp_path):
+    """Corruption paths of the failover restart (round-16 satellite):
+    a truncated ``shard-<pid>.npz`` (writer SIGKILLed mid-write), a
+    sidecar/payload span mismatch (mixed checkpoint generations), and a
+    torn final NDJSON line in a per-host digest stream — each refused
+    loudly with a recovery hint (or, for the torn tail, tolerated per
+    the PR-7 contract), never an unhandled traceback."""
+    ctx = bootstrap.DistContext(0, 1, None, False)
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+    st = S.init_batch(P_SER, SEEDS)
+    padded, n_valid = sharded.pad_to_multiple(P_SER, st, mesh2.size)
+    dev = mesh_ops.shard_batch(mesh2, padded)
+    d = str(tmp_path / "ck.d")
+    egress.save_shards(d, dev, n_valid, mesh2, ctx)
+    bin_path = os.path.join(d, "shard-0.npz")
+    with open(bin_path, "rb") as f:
+        blob = f.read()
+
+    # (a) Truncated archive: a clean ValueError naming the shard and the
+    # likely cause — np.load's zipfile internals never escape.
+    with open(bin_path, "wb") as f:
+        f.write(blob[: len(blob) // 3])
+    with pytest.raises(ValueError, match="unreadable checkpoint shard"):
+        egress.merge_shards(d)
+
+    # (b) Sidecar/payload span mismatch: the sidecar promises 6 rows,
+    # the archive block holds 5 — concatenating would silently corrupt
+    # the resumed fleet, so the merge refuses before assembling.
+    with open(bin_path, "wb") as f:
+        f.write(blob)
+    side_path = os.path.join(d, "shard-0.json")
+    with open(side_path) as f:
+        side = json.load(f)
+    side["spans"] = [[0, 6]]
+    side["n_valid"] = 6
+    with open(side_path, "w") as f:
+        json.dump(side, f)
+    with pytest.raises(ValueError, match="sidecar span .* disagree"):
+        egress.merge_shards(d)
+
+    # (c) Torn final NDJSON line in a per-host digest stream (the
+    # timeout-kill signature): the intact prefix loads, and the merged
+    # fleet_watch view still renders; corrupt NON-final rows stay loud.
+    path = egress.host_stream_path(str(tmp_path / "fleet.ndjson"), 0)
+    dg = np.zeros((tstream.DIGEST_WIDTH,), np.int64)
+    rec = tstream.TimelineRecorder(
+        P_SER, total_instances=6, out=path,
+        meta={"process_id": 0, "process_count": 1})
+    rec.record(dg, steps=32)
+    rec.close()
+    with open(path) as f:
+        whole = f.read()
+    with open(path, "a") as f:
+        f.write('{"kind": "digest", "chunk": 99, "torn')  # no newline
+    meta, rows = tstream.load_ndjson(path)
+    assert len(rows) == 1 and rows[0]["chunk"] == 0
+    rc = fleet_watch.main([str(tmp_path / "fleet.p*.ndjson"),
+                           "--merge", "--once"])
+    assert rc == 0
+    with open(path, "w") as f:
+        f.write(whole.splitlines()[0] + "\n" + '{"torn": mid\n'
+                + whole.splitlines()[-1] + "\n")
+    with pytest.raises(ValueError):
+        tstream.load_ndjson(path)
 
 
 def test_bootstrap_env_knobs(monkeypatch):
