@@ -1,0 +1,10 @@
+"""Adversary engine: vectorized attack schedules + per-link network planes.
+
+``plane`` holds the traced tensor schema (the ``[W, ADV_FIELDS]``
+attack-schedule plane, the ``[n, n]`` link-delay matrix, the partition
+row) and the in-graph decode forms both engines share; ``dsl`` is the
+host-side attack-program language that validates and lowers to plane
+rows.  See README "Adversary engine".
+"""
+
+from . import dsl, plane  # noqa: F401

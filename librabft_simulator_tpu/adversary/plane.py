@@ -1,0 +1,250 @@
+"""The adversary plane: traced per-slot attack state + its in-graph decode.
+
+Schema (all int32, the R2 discipline; every array rides in
+``SimState``/``PSimState`` as per-slot traced DATA, so one compiled
+executable serves millions of distinct attack scenarios — the same
+move the scenario plane made for delay/commit knobs):
+
+* ``adv_sched`` — ``[W, ADV_FIELDS]`` attack-schedule plane, one row per
+  window: ``(mode, lo, hi, behavior, target_lo, target_hi, arg)``.  A
+  window is ACTIVE when its mode's key (event time, instance event
+  count, or the handled node's epoch) lies in ``[lo, hi)``; its behavior
+  then applies to the nodes whose bit is set in the 64-bit
+  ``(target_lo, target_hi)`` author mask.  The all-zero row is inert
+  (``hi = 0`` never admits a key >= 0), so a zero plane is the off
+  schedule by construction.
+* ``adv_link`` — ``[n, n]`` per-link extra-delay matrix: message latency
+  on link ``(sender, receiver)`` gains ``clip(adv_link[s, r], 0, CAP)``
+  on top of the drawn table delay.  Zero = the uniform network.
+* ``adv_group`` / ``adv_heal`` — the partition schedule: a message sent
+  at time ``t < adv_heal[0]`` between nodes in DIFFERENT groups is cut
+  (dropped, counted in ``n_msgs_dropped``); from ``heal`` on, the
+  network is whole again.  All-equal groups or ``heal = 0`` = no
+  partition.
+
+Decode discipline: one-hot/select/elementwise forms only — no scalar
+scatters (the R1 miscompile class), nothing written back (the plane is
+READ-ONLY per-slot config; the graph audit's R6 adversary arm pins the
+pass-through).  Every decode is replayed exactly by the oracle through
+:class:`HostPlane`, so windowed attacks stay inside the bit-parity
+contract.
+
+Lane-engine lookahead: per-link extra delays only ADD latency, so the
+minimum live-link extra (:func:`link_lookahead`) soundly TIGHTENS the
+Chandy–Misra horizon from the global ``t_min + d_min`` bound to
+``t_min + d_min + min_link`` — a raw-speed win on delay-skewed matrices
+(wider windows, fewer dispatches).  Partitions only REMOVE messages and
+window-scoped delays only add, so neither can break the bound.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import ADV_FIELDS, NEVER, SimParams
+
+I32 = jnp.int32
+
+# Field offsets of one [ADV_FIELDS] schedule row.
+F_MODE, F_LO, F_HI, F_BEH, F_TGT_LO, F_TGT_HI, F_ARG = range(ADV_FIELDS)
+
+# Window bound modes: what key the [lo, hi) interval is tested against.
+MODE_TIME = 0    # event (global) time — partitions-that-heal, timed attacks
+MODE_EVENTS = 1  # instance event count — "after N events" attacks (the
+                 # lane engine evaluates this at WINDOW granularity: all
+                 # events of one horizon window see the window-start count)
+MODE_EPOCH = 2   # the handled node's pre-event epoch — epoch-boundary
+                 # attacks (arm exactly while a node is in epoch e)
+MODES = ("time", "events", "epoch")
+
+# Behavior selectors.  1..3 generalize the static byz_* masks into
+# windowed activations (OR-composed onto the static masks per event);
+# 4..5 are the network behaviors (extra delay on messages TO the targeted
+# receivers / to the sender's current-round leader, amount = arg,
+# overlapping windows compose by MAX).
+BEH_NONE = 0
+BEH_EQUIVOCATE = 1
+BEH_SILENT = 2
+BEH_FORGE_QC = 3
+BEH_DELAY = 4
+BEH_DELAY_LEADER = 5
+BEHAVIORS = ("none", "equivocate", "silent", "forge_qc", "delay",
+             "delay_leader")
+
+#: Hard cap on any adversarial delay contribution (per-link entry or
+#: window arg), clamped in-graph AND validated by the DSL: arrival times
+#: are int32 and the engines add delays without saturation, so adversary
+#: data must never be able to wrap the clock.
+DELAY_CAP = 1 << 20
+
+
+# ---------------------------------------------------------------------------
+# Device decode (traced; shared by both engines).
+# ---------------------------------------------------------------------------
+
+
+def active_windows(sched, t, ev, epoch):
+    """``[W]`` bool: each window's ``[lo, hi)`` test against its mode's
+    key — ``t`` (event time), ``ev`` (instance event count), or ``epoch``
+    (the handled node's pre-event epoch), all scalar int32."""
+    mode = sched[:, F_MODE]
+    key = jnp.where(mode == MODE_TIME, jnp.asarray(t, I32),
+                    jnp.where(mode == MODE_EVENTS, jnp.asarray(ev, I32),
+                              jnp.asarray(epoch, I32)))
+    return (key >= sched[:, F_LO]) & (key < sched[:, F_HI])
+
+
+def _target_hit(sched, node):
+    """Bit of ``node`` (any shape) in each window's 64-bit author mask:
+    bool ``[W, *node.shape]``.  ``(word >> bit) & 1`` reads the bit
+    correctly under arithmetic int32 shifts (low bits are fill-invariant),
+    so an all-ones mask stores as the int32 ``-1``."""
+    node = jnp.asarray(node, I32)
+    ext = (sched.shape[0],) + (1,) * node.ndim
+    lo = sched[:, F_TGT_LO].reshape(ext)
+    hi = sched[:, F_TGT_HI].reshape(ext)
+    nd = node[None]
+    word = jnp.where(nd < 32, lo, hi)
+    bit = jnp.clip(jnp.where(nd < 32, nd, nd - 32), 0, 31)
+    return ((word >> bit) & 1) != 0
+
+
+def behavior_hit(sched, active, beh, node):
+    """Any active window with behavior ``beh`` targeting ``node``: bool
+    of ``node``'s shape (scalar for the serial engine's handled node,
+    ``[A]`` for the lane compaction)."""
+    node = jnp.asarray(node, I32)
+    on = active & (sched[:, F_BEH] == beh)
+    ext = on.reshape((on.shape[0],) + (1,) * node.ndim)
+    return jnp.any(ext & _target_hit(sched, node), axis=0)
+
+
+def node_masks(sched, active, node):
+    """(equivocate, silent, forge_qc) windowed activations for ``node`` —
+    the decode the engines OR onto the static ``byz_*`` masks."""
+    return (behavior_hit(sched, active, BEH_EQUIVOCATE, node),
+            behavior_hit(sched, active, BEH_SILENT, node),
+            behavior_hit(sched, active, BEH_FORGE_QC, node))
+
+
+def delay_extra(sched, active, recvs, leader):
+    """Window-scoped extra delay per candidate receiver: int32 of
+    ``recvs``'s shape — the MAX over active delay windows of ``arg``,
+    where a window applies to receiver ``r`` if ``BEH_DELAY`` targets it
+    or ``BEH_DELAY_LEADER`` and ``r == leader`` (``leader`` must
+    broadcast against ``recvs``)."""
+    recvs = jnp.asarray(recvs, I32)
+    ext = lambda v: v.reshape((sched.shape[0],) + (1,) * recvs.ndim)  # noqa: E731
+    arg = jnp.clip(sched[:, F_ARG], 0, DELAY_CAP)
+    beh = sched[:, F_BEH]
+    applies = ((ext(active & (beh == BEH_DELAY)) & _target_hit(sched, recvs))
+               | (ext(active & (beh == BEH_DELAY_LEADER))
+                  & (recvs[None] == jnp.asarray(leader, I32))))
+    return jnp.max(jnp.where(applies, ext(arg), 0), axis=0)
+
+
+def link_lookahead(link, n: int):
+    """Minimum off-diagonal per-link extra delay (scalar int32, >= 0):
+    the amount by which EVERY message's latency exceeds the delay-table
+    bound, hence the sound tightening the lane engine adds to its
+    Chandy–Misra horizon.  (Partition cuts only remove messages and
+    window delays only add, so neither loosens this bound; n == 1 has no
+    links and any horizon is vacuously sound.)"""
+    off = ~jnp.eye(n, dtype=bool)
+    return jnp.min(jnp.where(off, jnp.clip(link, 0, DELAY_CAP), DELAY_CAP))
+
+
+# ---------------------------------------------------------------------------
+# Host mirror (oracle + minidump reporter).
+# ---------------------------------------------------------------------------
+
+
+class HostPlane:
+    """Plain-Python twin of the device decode, built from the lowered
+    numpy rows — the oracle (oracle/sim.py) replays every adversary
+    decision through this class, so any engine/decode divergence shows as
+    a parity failure, and ``describe()`` is the decoded-program record
+    fuzz minidumps carry."""
+
+    def __init__(self, sched, link, group, heal):
+        self.sched = [[int(v) for v in row] for row in np.asarray(sched)]
+        self.link = np.asarray(link, np.int64)
+        self.group = [int(g) for g in np.asarray(group)]
+        self.heal = int(np.asarray(heal).reshape(-1)[0]) if np.asarray(
+            heal).size else 0
+
+    def _active(self, t: int, ev: int, epoch: int) -> list[bool]:
+        out = []
+        for row in self.sched:
+            key = (t if row[F_MODE] == MODE_TIME
+                   else ev if row[F_MODE] == MODE_EVENTS else epoch)
+            out.append(row[F_LO] <= key < row[F_HI])
+        return out
+
+    @staticmethod
+    def _targets(row, node: int) -> bool:
+        word = row[F_TGT_LO] if node < 32 else row[F_TGT_HI]
+        return ((word >> min(max(node if node < 32 else node - 32, 0), 31))
+                & 1) != 0
+
+    def node_masks(self, t, ev, epoch, node) -> tuple[bool, bool, bool]:
+        act = self._active(t, ev, epoch)
+        out = []
+        for beh in (BEH_EQUIVOCATE, BEH_SILENT, BEH_FORGE_QC):
+            out.append(any(
+                a and row[F_BEH] == beh and self._targets(row, node)
+                for a, row in zip(act, self.sched)))
+        return tuple(out)
+
+    def delay_extra(self, t, ev, epoch, recv, leader) -> int:
+        act = self._active(t, ev, epoch)
+        best = 0
+        for a, row in zip(act, self.sched):
+            if not a:
+                continue
+            hit = ((row[F_BEH] == BEH_DELAY and self._targets(row, recv))
+                   or (row[F_BEH] == BEH_DELAY_LEADER and recv == leader))
+            if hit:
+                best = max(best, min(max(row[F_ARG], 0), DELAY_CAP))
+        return best
+
+    def link_extra(self, sender: int, recv: int) -> int:
+        return int(min(max(self.link[sender, recv], 0), DELAY_CAP))
+
+    def cut(self, sender: int, recv: int, t: int) -> bool:
+        return self.group[sender] != self.group[recv] and t < self.heal
+
+    def describe(self) -> dict:
+        """Decoded program for minidumps/results: named windows + the
+        network rows (the counterexample reporter contract)."""
+        windows = []
+        for row in self.sched:
+            if row[F_HI] <= row[F_LO] or row[F_BEH] == BEH_NONE:
+                continue
+            tgt = (row[F_TGT_LO] & 0xFFFFFFFF) \
+                | ((row[F_TGT_HI] & 0xFFFFFFFF) << 32)
+            windows.append(dict(
+                behavior=BEHAVIORS[row[F_BEH]], mode=MODES[row[F_MODE]],
+                lo=row[F_LO], hi=row[F_HI],
+                targets=[i for i in range(64) if (tgt >> i) & 1],
+                arg=row[F_ARG]))
+        return dict(
+            windows=windows,
+            link=self.link.tolist() if self.link.size else [],
+            groups=self.group,
+            heal=self.heal if self.heal < int(NEVER) else "never")
+
+
+def default_rows(p: SimParams) -> dict:
+    """The inert (all-quiet) plane rows for ``p`` — numpy, the same
+    zero-filled values ``types.adv_*_init`` traces, for host-side row
+    assembly (serve admission, DSL lowering base)."""
+    w = p.adv_windows if p.adversary else 0
+    n = p.n_nodes if p.adversary else 0
+    return dict(
+        adv_sched=np.zeros((w, ADV_FIELDS), np.int32),
+        adv_link=np.zeros((n, n), np.int32),
+        adv_group=np.zeros((n,), np.int32),
+        adv_heal=np.zeros((1 if p.adversary else 0,), np.int32),
+    )

@@ -131,10 +131,16 @@ R1_EXPECTED_WAIVED = {
     # traced data; no new write sites — the plane is READ-only config
     # (the R6 scenario arm pins pass-through).
     "serial/tpu_shape_scenario": 1,
+    # Adversary-plane flavor (SimParams.adversary): the attack-schedule /
+    # link / partition decode is one-hot/select forms only — no new
+    # write sites (the plane is READ-only config; the R6 adversary arm
+    # pins pass-through).
+    "serial/tpu_shape_adversary": 1,
     "lane/tpu_shape": 13,         # lane scatter-back + inbox routing
     "lane/tpu_telemetry": 14,     # + the flight-recorder ring scatter
     "lane/tpu_watchdog": 13,
     "lane/tpu_shape_scenario": 13,
+    "lane/tpu_shape_adversary": 13,
 }
 
 
@@ -610,6 +616,12 @@ def _flavors(base_kw: dict, engine_name: str = "serial"):
     # off-inert / read-only pass-through pins.
     flavors.append(("tpu_shape_scenario", dict(TPU_FORMS, scenario=True),
                     ("R1", "R2", "R3", "R4")))
+    # Adversary-plane flavor (SimParams.adversary; adversary/): the
+    # windowed attack decode, per-link delay adds, and partition cuts.
+    # Same write/dtype/callback/carry rules on the adversary graph; the
+    # R6 adversary arm adds the off-inert / read-only pass-through pins.
+    flavors.append(("tpu_shape_adversary", dict(TPU_FORMS, adversary=True),
+                    ("R1", "R2", "R3", "R4")))
     if engine_name == "serial":
         flavors += [
             ("tpu_shape_k4", dict(TPU_FORMS, macro_k=4),
@@ -649,19 +661,20 @@ def check_r6_macro(engine_name: str, base_kw: dict,
     return []
 
 
-def check_r6_scenario(engine_name: str, base_kw: dict,
-                      traces: dict | None = None) -> list[Finding]:
-    """The scenario plane's R6 arm — two static pins:
+def _check_r6_plane(engine_name: str, base_kw: dict, traces: dict,
+                    leaf_substrings: tuple, n_leaves: int, what: str,
+                    on_flavor: str, on_kw: dict) -> list[Finding]:
+    """Shared R6 arm for a per-slot traced-config PLANE (the scenario and
+    adversary planes both ride it) — two static pins:
 
-    * **off-inert**: with ``scenario=False`` the sc_* state leaves are
+    * **off-inert**: with the knob OFF the plane's state leaves are
       zero-width and NO eqn consumes them — the step graph is the exact
-      static-knob lowering (the census twin: existing budgets unchanged);
-    * **read-only pass-through**: with ``scenario=True`` the step must
-      return ``sc_delay``/``sc_commit`` as the IDENTITY of its inputs
-      (the same jaxpr Var) — the plane is per-slot config, and an engine
-      write to it would let one chunk silently rewrite a slot's scenario
-      out from under the resident service's admission bookkeeping."""
-    traces = dict(traces or {})
+      knob-free lowering (the census twin: existing budgets unchanged);
+    * **read-only pass-through**: with the knob ON the step must return
+      every plane leaf as the IDENTITY of its input (the same jaxpr
+      Var) — the plane is per-slot config, and an engine write to it
+      would let one chunk silently rewrite a slot's config out from
+      under the resident service's admission bookkeeping."""
     findings = []
 
     def get(name, **kw):
@@ -671,21 +684,20 @@ def check_r6_scenario(engine_name: str, base_kw: dict,
             traces[name] = (cj, paths)
         return traces[name]
 
-    def sc_slots(cj, paths):
-        invars = cj.jaxpr.invars
-        offset = len(invars) - len(paths)
+    def plane_slots(cj, paths):
+        offset = len(cj.jaxpr.invars) - len(paths)
         idx = [i for i, pth in enumerate(paths)
-               if ".sc_delay" in pth or ".sc_commit" in pth]
+               if any(leaf in pth for leaf in leaf_substrings)]
         return offset, idx
 
     cj_off, paths_off = get("tpu_shape")
-    offset, idx = sc_slots(cj_off, paths_off)
-    if len(idx) != 2:
+    offset, idx = plane_slots(cj_off, paths_off)
+    if len(idx) != n_leaves:
         findings.append(Finding(
             "R6", f"{engine_name}/tpu_shape", "error",
-            f"expected the 2 zero-width scenario leaves in the off state "
-            f"(sc_delay, sc_commit), found {len(idx)} — the state layout "
-            "drifted from the audited contract", ""))
+            f"expected the {n_leaves} zero-width {what} leaves in the "
+            f"off state, found {len(idx)} — the state layout drifted "
+            "from the audited contract", ""))
         return findings
     off_vars = {cj_off.jaxpr.invars[offset + i] for i in idx}
     for eqn in cj_off.jaxpr.eqns:
@@ -694,21 +706,45 @@ def check_r6_scenario(engine_name: str, base_kw: dict,
         if used:
             findings.append(Finding(
                 "R6", f"{engine_name}/tpu_shape", "error",
-                f"scenario-OFF graph consumes a zero-width sc leaf in "
+                f"{what}-OFF graph consumes a zero-width plane leaf in "
                 f"{eqn.primitive.name} — the off graph must be the exact "
-                "static lowering (census budgets depend on it)",
+                "knob-free lowering (census budgets depend on it)",
                 eqn_site(eqn)))
-    cj_on, paths_on = get("tpu_shape_scenario", scenario=True)
-    offset_on, idx_on = sc_slots(cj_on, paths_on)
+    cj_on, paths_on = get(on_flavor, **on_kw)
+    offset_on, idx_on = plane_slots(cj_on, paths_on)
     for i in idx_on:
         if cj_on.jaxpr.outvars[i] is not cj_on.jaxpr.invars[offset_on + i]:
             findings.append(Finding(
-                "R6", f"{engine_name}/tpu_shape_scenario", "error",
-                f"scenario plane leaf {paths_on[i]} is not passed through "
+                "R6", f"{engine_name}/{on_flavor}", "error",
+                f"{what} plane leaf {paths_on[i]} is not passed through "
                 "unchanged — the plane is read-only per-slot config; an "
-                "engine write to it would rewrite a slot's scenario out "
+                "engine write to it would rewrite a slot's config out "
                 "from under the admission bookkeeping", ""))
     return findings
+
+
+def check_r6_scenario(engine_name: str, base_kw: dict,
+                      traces: dict | None = None) -> list[Finding]:
+    """The scenario plane's R6 arm (see :func:`_check_r6_plane`)."""
+    return _check_r6_plane(
+        engine_name, base_kw, dict(traces or {}),
+        (".sc_delay", ".sc_commit"), 2, "scenario",
+        "tpu_shape_scenario", dict(scenario=True))
+
+
+_ADV_LEAVES = (".adv_sched", ".adv_link", ".adv_group", ".adv_heal")
+
+
+def check_r6_adversary(engine_name: str, base_kw: dict,
+                       traces: dict | None = None) -> list[Finding]:
+    """The adversary plane's R6 arm (see :func:`_check_r6_plane`): the
+    attack-state leaves are off-inert and read-only — an engine write to
+    them would additionally invalidate the lane engine's link-derived
+    horizon mid-window."""
+    return _check_r6_plane(
+        engine_name, base_kw, dict(traces or {}),
+        _ADV_LEAVES, len(_ADV_LEAVES), "adversary",
+        "tpu_shape_adversary", dict(adversary=True))
 
 
 def audit_engine(engine_name: str, base_kw: dict, r6: bool = True,
@@ -753,6 +789,7 @@ def audit_engine(engine_name: str, base_kw: dict, r6: bool = True,
         findings += check_r6_engine(engine_name, base_kw, engine_name,
                                     traces=traces)
         findings += check_r6_scenario(engine_name, base_kw, traces=traces)
+        findings += check_r6_adversary(engine_name, base_kw, traces=traces)
         if engine_name == "serial":
             findings += check_r6_macro(engine_name, base_kw, traces=traces)
     return findings, stats

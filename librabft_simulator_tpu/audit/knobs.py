@@ -233,6 +233,19 @@ KNOBS: tuple[Knob, ...] = (
          "Byzantine schedule/seed) on ONE scenario-armed executable and "
          "pins each slot against its own oracle; minidumps record the "
          "full plane.  Writes FUZZ_PARITY_r14_scenario.json."),
+    Knob("FUZZ_ADVERSARY", "fuzz", "scripts/fuzz_parity.py", "0|1",
+         "Adversary-engine campaign mode: every trial runs a randomized "
+         "attack program (windowed equivocation/silence/forged QCs, "
+         "targeted + leader-targeted delay, per-link matrices, "
+         "partition-with-heal — adversary/dsl.sample_program) on the "
+         "adversary-armed serial engine and checks full oracle parity; "
+         "minidumps record the DECODED program.  Writes "
+         "FUZZ_PARITY_r17_adversary.json."),
+    Knob("LIBRABFT_ADV_WINDOWS", "fuzz", "scripts/fuzz_parity.py",
+         "int >= 1",
+         "FUZZ_ADVERSARY campaign: attack-schedule window capacity W of "
+         "the fuzzed plane (SimParams.adv_windows; default 4).  A "
+         "compile key — each W is one executable per structural shape."),
     # --- script-local ---------------------------------------------------
     Knob("LADDER_UNROLL", "script", "scripts/tpu_ladder.py", "0|1",
          "Census/ladder the unrolled-scan variant."),

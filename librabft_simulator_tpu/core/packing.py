@@ -164,6 +164,10 @@ class PackedSimState:
     wd: Array
     sc_delay: Array
     sc_commit: Array
+    adv_sched: Array
+    adv_link: Array
+    adv_group: Array
+    adv_heal: Array
 
 
 _SIM_COMMON = _common_fields(SimState)

@@ -71,6 +71,12 @@ DELAY_KEY_DEFAULTS = dict(delay_kind="lognormal", delay_mean=10.0,
                           delay_variance=4.0, delay_pareto_scale=5.0,
                           delay_pareto_alpha=1.5)
 
+#: Width of one attack-schedule window row (adversary/plane.py — the
+#: schema constants live there; the width lives here so the zero-width
+#: state init below needs no adversary import): (mode, lo, hi, behavior,
+#: target_lo, target_hi, arg).
+ADV_FIELDS = 7
+
 
 @dataclasses.dataclass(frozen=True)
 class SimParams:
@@ -231,6 +237,25 @@ class SimParams:
     # kernel-census gates); per-slot values are bit-identical to a
     # dedicated static run of the same scenario.
     scenario: bool = False
+    # Adversary engine (adversary/): per-slot traced attack state — a
+    # [W, ADV_FIELDS] attack-schedule plane (time/event/epoch-windowed
+    # equivocation, targeted silence, forged QCs, targeted and
+    # leader-targeted delay — decoded in-graph with one-hot/select forms
+    # and OR-composed onto the static byz_* masks per event), a [n, n]
+    # per-link extra-delay matrix (consumed by both engines' delay
+    # draws; the lane engine derives a TIGHTER Chandy–Misra horizon
+    # from its minimum off-diagonal entry), and a partition schedule
+    # (group row + heal time: crossing messages sent before heal are
+    # cut).  Attack programs (adversary/dsl.py) lower to these rows, so
+    # one executable sweeps millions of distinct adversarial scenarios.
+    # Static and default OFF: disabled, the adv_* leaves are zero-width
+    # and every decode compiles out — the graph is bit- and
+    # kernel-identical to an adversary-free build (tests/
+    # test_adversary.py + the kernel-census gates + the graph audit's
+    # R6 adversary arm).
+    adversary: bool = False
+    adv_windows: int = 4      # W: attack-schedule rows per slot (compile
+                              # key: the plane's shape)
 
     def __post_init__(self):
         if self.epoch_handoff and self.handoff_epochs < 1:
@@ -253,6 +278,17 @@ class SimParams:
                 f"watchdog_stall_events must be >= 1 when the watchdog is "
                 f"on (got {self.watchdog_stall_events}); a zero threshold "
                 "would trip the liveness-stall detector on every event")
+        if self.adversary and self.adv_windows < 1:
+            raise ValueError(
+                f"adv_windows must be >= 1 when the adversary plane is on "
+                f"(got {self.adv_windows}); a zero-row schedule cannot "
+                "hold any attack window — turn adversary off instead")
+        if self.adversary and self.n_nodes > 64:
+            raise ValueError(
+                f"the adversary plane's author target masks cover 64 "
+                f"nodes (n_nodes={self.n_nodes}); widen the "
+                "target_lo/target_hi fields before arming larger "
+                "committees")
         if self.scenario and self.commit_chain not in (2, 3):
             raise ValueError(
                 f"commit_chain must be 2 (HotStuff-style) or 3 "
@@ -336,6 +372,36 @@ def sc_commit_init(p: SimParams):
     if not p.scenario:
         return jnp.zeros((0,), jnp.int32)
     return jnp.full((1,), p.commit_chain, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Adversary plane (SimParams.adversary; adversary/plane.py holds the
+# schema + decode).  The all-zero rows are the inert program by
+# construction: a window with hi=0 never activates, a zero link matrix
+# adds nothing, all-equal groups with heal=0 never cut.
+# ---------------------------------------------------------------------------
+
+
+def adv_sched_init(p: SimParams):
+    """Inert attack-schedule plane: [W, ADV_FIELDS] zeros ([0, F] off)."""
+    return jnp.zeros((p.adv_windows if p.adversary else 0, ADV_FIELDS),
+                     jnp.int32)
+
+
+def adv_link_init(p: SimParams):
+    """Zero per-link extra-delay matrix: [n, n] ([0, 0] off)."""
+    n = p.n_nodes if p.adversary else 0
+    return jnp.zeros((n, n), jnp.int32)
+
+
+def adv_group_init(p: SimParams):
+    """All-same partition groups: [n] zeros ([0] off)."""
+    return jnp.zeros((p.n_nodes if p.adversary else 0,), jnp.int32)
+
+
+def adv_heal_init(p: SimParams):
+    """Heal-at-0 (= never partitioned): [1] zeros ([0] off)."""
+    return jnp.zeros((1 if p.adversary else 0,), jnp.int32)
 
 
 class TracedParams:
@@ -836,3 +902,13 @@ class SimState:
     # graph audit's scenario R6 arm).
     sc_delay: Array     # [T] int32 delay table row ([0] when off)
     sc_commit: Array    # [1] int32 commit-chain (2|3; [0] when off)
+    # Adversary plane (SimParams.adversary; adversary/): per-slot traced
+    # attack state — the windowed attack schedule, per-link extra-delay
+    # matrix, and partition row the engines decode in-graph.  All
+    # zero-width when off; READ-ONLY config when on (pass-through pinned
+    # by the graph audit's R6 adversary arm), installed by
+    # adversary/dsl.AttackProgram.install or per-slot via serve/.
+    adv_sched: Array    # [W, ADV_FIELDS] int32 ([0, F] when off)
+    adv_link: Array     # [n, n] int32 per-link extra delay ([0, 0] off)
+    adv_group: Array    # [n] int32 partition group ([0] when off)
+    adv_heal: Array     # [1] int32 partition heal time ([0] when off)

@@ -62,8 +62,12 @@ def build_parser():
                     help="3 = LibraBFTv2 3-chain, 2 = HotStuff-style 2-chain")
     ap.add_argument("--byzantine_f", type=int, default=0,
                     help="Number of faulty authors (0..n/3)")
+    # Choices come from THE schedule registry (sim/byzantine.SCHEDULES) so
+    # a newly registered schedule can never silently vanish from the CLI
+    # (the drift this replaces: the flag offered 2 of the 4 registered
+    # kinds).  "honest" is valid and means f faulty authors doing nothing.
     ap.add_argument("--byzantine_kind", default="equivocate",
-                    choices=["equivocate", "silent"])
+                    choices=list(B.SCHEDULES))
     ap.add_argument("--json", action="store_true", help="JSON summary to stdout")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="force a JAX backend (some TPU plugins ignore "

@@ -403,10 +403,17 @@ class Message:
 
 
 class OracleSim:
-    """Mirror of sim/simulator.py::step over plain Python state."""
+    """Mirror of sim/simulator.py::step over plain Python state.
+
+    ``attack`` mirrors the adversary plane (adversary/): an
+    ``AttackProgram`` (or its dict form, or a pre-lowered
+    ``plane.HostPlane``) whose windowed behaviors, per-link delays, and
+    partition cuts are replayed per event through the host decode twin —
+    the bit-parity reference for every adversarial scenario."""
 
     def __init__(self, p: SimParams, seed: int, weights=None,
-                 byz_equivocate=None, byz_silent=None, byz_forge_qc=None):
+                 byz_equivocate=None, byz_silent=None, byz_forge_qc=None,
+                 attack=None):
         self.p = p
         self.seed = seed & E.M32
         n = p.n_nodes
@@ -418,6 +425,18 @@ class OracleSim:
         self.byz_silent = list(byz_silent) if byz_silent is not None else [False] * n
         self.byz_forge_qc = list(byz_forge_qc) if byz_forge_qc is not None \
             else [False] * n
+        if attack is None:
+            self.adv = None
+        else:
+            from ..adversary import dsl as adsl
+            from ..adversary import plane as aplane
+
+            if isinstance(attack, aplane.HostPlane):
+                self.adv = attack
+            else:
+                if isinstance(attack, dict):
+                    attack = adsl.AttackProgram.from_dict(attack)
+                self.adv = attack.host_plane(p)
         self.stores = [E.Store(p) for _ in range(n)]
         self.pms = [Pacemaker() for _ in range(n)]
         self.nxs = [NodeExtra() for _ in range(n)]
@@ -556,6 +575,20 @@ class OracleSim:
         cc_pre = cx.commit_count  # pre-handler, matching the device's cx_a
         sync_pre = cx.sync_jumps  # pre-handler, for the sync-jump detector
 
+        # Adversary plane decode (mirrors sim/simulator.py): keys are the
+        # event time, the PRE-event count, and the handled node's
+        # PRE-handler epoch; windowed behaviors OR onto the static masks.
+        ev_pre = self.n_events
+        ep_pre = s.epoch_id
+        if self.adv is not None:
+            adv_eq, adv_sil, adv_forge = self.adv.node_masks(
+                clock, ev_pre, ep_pre, a)
+        else:
+            adv_eq = adv_sil = adv_forge = False
+        eff_equiv = self.byz_equivocate[a] or adv_eq
+        eff_silent = self.byz_silent[a] or adv_sil
+        eff_forge = self.byz_forge_qc[a] or adv_forge
+
         should_sync = False
         if is_notify:
             should_sync = handle_notification(p, s, self.weights, pay_in)
@@ -597,7 +630,7 @@ class OracleSim:
                 self.tel["commit_lats"].append(max(
                     clock - (s.blk_time[sl][v_c] + self.startup[author_b]), 0))
 
-        silent = self.byz_silent[a]
+        silent = eff_silent
         want_sync_req = is_notify and should_sync and not silent
         want_response = is_request and not silent
         cand0_want = want_sync_req or want_response
@@ -621,12 +654,12 @@ class OracleSim:
 
         # Payload bank (mirrors simulator.py: computed on the post-update store).
         notif = create_notification(p, s, a)
-        if self.byz_forge_qc[a]:
+        if eff_forge:
             notif = self._forged_qc(s, a, notif)
         notif_b = self._equivocated(notif)
         request = create_request(p, s)
         response = handle_request(p, s, a, pay_in)
-        if self.byz_forge_qc[a]:
+        if eff_forge:
             # The tensor path builds the response from the (forged) notif.
             response.hqc = copy.deepcopy(notif.hqc)
 
@@ -652,7 +685,7 @@ class OracleSim:
         upper = [(i * 2 >= n) for i in range(n)]
         pays = [response if want_response else request]
         for i in recv_order:
-            pays.append(notif_b if (self.byz_equivocate[a] and upper[i]) else notif)
+            pays.append(notif_b if (eff_equiv and upper[i]) else notif)
         pays += [request] * n
 
         timer_gap = 1 if do_update else 0
@@ -665,6 +698,12 @@ class OracleSim:
         total_consumed = sum(want) + timer_gap
         timer_stamp_new = self.stamp_ctr + (1 if cand0_want else 0)
 
+        # Leader of the handled node's post-update pacemaker round: the
+        # delay_leader behavior's target (mirrors the device's
+        # config.leader_of_round(st.weights, pm_f.active_round)).
+        adv_leader = (E.leader_of_round(self.weights, pm.active_round)
+                      if self.adv is not None else -1)
+
         free_slots = [i for i, m in enumerate(self.queue) if not m.valid]
         rank = 0
         for j, w in enumerate(want):
@@ -674,6 +713,14 @@ class OracleSim:
             u_drop = E.mix32(u_delay, 0x632BE59B)
             delay = int(self.delay_table[u_delay >> (32 - TABLE_BITS)])
             dropped = u_drop < p.drop_u32
+            if self.adv is not None:
+                # Network plane: per-link + windowed delay extras ride on
+                # the drawn latency; partition-crossing sends before heal
+                # are cut (counted with the rng drops, once per message).
+                delay += (self.adv.link_extra(a, recvs[j])
+                          + self.adv.delay_extra(clock, ev_pre, ep_pre,
+                                                 recvs[j], adv_leader))
+                dropped = dropped or self.adv.cut(a, recvs[j], clock)
             if dropped:
                 self.n_msgs_dropped += 1
                 continue

@@ -11,12 +11,19 @@ Request schema (one JSON object per line)::
 
     {"id": "req-1", "delay_kind": "pareto", "delay_pareto_scale": 2.0,
      "drop_prob": 0.05, "commit_chain": 2, "byz_kind": "silent",
-     "byz_f": 1, "seed": 7, "max_clock": 1200}
+     "byz_f": 1, "seed": 7, "max_clock": 1200,
+     "attack": {"windows": [{"behavior": "equivocate", "start": 100,
+                             "end": 400, "targets": [0]}],
+                "partition": {"groups": [[0, 1], [2, 3]], "heal": 300}}}
 
 Every field except ``id`` is a :class:`serve.scenario.ScenarioSpec` field
 (all optional — defaults are the base params' scenario); unknown fields
-fail loud.  Results stream back as ``kind="request" event="egressed"``
-rows on the service NDJSON (and from :meth:`FleetService.drain`).
+fail loud.  ``attack`` takes the adversary/dsl.py program grammar and
+needs an adversary-armed base (``SimParams.adversary=True``); the
+egressed result then carries the decoded program and — with the
+watchdog armed — the per-request safety/liveness trip counts.  Results
+stream back as ``kind="request" event="egressed"`` rows on the service
+NDJSON (and from :meth:`FleetService.drain`).
 """
 
 from __future__ import annotations

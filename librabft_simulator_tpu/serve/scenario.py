@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ..adversary import dsl as adsl
+from ..adversary import plane as aplane
 from ..core.types import SimParams
 from ..sim import byzantine
 from ..sim import simulator as sim_ops
@@ -61,6 +63,12 @@ class ScenarioPlane:
     byz_equivocate: jnp.ndarray  # [N] bool
     byz_silent: jnp.ndarray      # [N] bool
     byz_forge_qc: jnp.ndarray    # [N] bool
+    # Adversary-plane rows (adversary/; zero-width when the base params'
+    # adversary knob is off): the slot's lowered attack program.
+    adv_sched: jnp.ndarray       # [W, ADV_FIELDS] int32
+    adv_link: jnp.ndarray        # [N, N] int32
+    adv_group: jnp.ndarray       # [N] int32
+    adv_heal: jnp.ndarray        # [1] int32
 
 
 #: The scenario-settable SimParams fields a spec overrides on its base
@@ -91,6 +99,10 @@ class ScenarioSpec:
     byz_f: int = 0
     byz_authors: tuple | None = None
     seed: int = 0
+    #: Attack program (adversary/dsl.py, the ``AttackProgram.from_dict``
+    #: grammar), admissible only on an adversary-armed base (the adv_*
+    #: plane leaves are zero-width otherwise).  None = the quiet program.
+    attack: dict | None = None
 
     def __post_init__(self):
         if self.byz_kind not in byzantine.SCHEDULES:
@@ -100,6 +112,10 @@ class ScenarioSpec:
         if self.commit_chain not in (2, 3):
             raise ValueError(
                 f"commit_chain must be 2 or 3, got {self.commit_chain}")
+        if self.attack is not None:
+            # Grammar check at construction (params-dependent checks —
+            # capacities, node ids — run at plane_row lowering time).
+            adsl.AttackProgram.from_dict(self.attack)
 
     @classmethod
     def from_dict(cls, d: dict) -> "ScenarioSpec":
@@ -130,10 +146,30 @@ class ScenarioSpec:
             base, self.byz_kind, self.byz_f,
             list(self.byz_authors) if self.byz_authors is not None else None)
 
+    def attack_program(self) -> "adsl.AttackProgram | None":
+        """The parsed attack program (None = quiet)."""
+        return (adsl.AttackProgram.from_dict(self.attack)
+                if self.attack is not None else None)
+
+    def adv_rows(self, base: SimParams) -> dict:
+        """The lowered adversary-plane rows of this scenario (inert rows
+        when no attack; loud error on an attack without the plane)."""
+        prog = self.attack_program()
+        if prog is None:
+            return aplane.default_rows(base)
+        if not base.adversary:
+            raise ValueError(
+                "scenario carries an attack program but the base params "
+                "have adversary=False — arm SimParams.adversary on the "
+                "fleet's base config (the adv_* plane leaves are "
+                "zero-width otherwise)")
+        return prog.lower(base)
+
     def plane_row(self, base: SimParams) -> ScenarioPlane:
         """This scenario as one (unbatched) plane row."""
         ded = self.to_params(base)
         eq, silent, forge = self.byz_masks(base)
+        adv = self.adv_rows(base)
         return ScenarioPlane(
             seed=jnp.uint32(self.seed & 0xFFFFFFFF),
             delay_table=jnp.asarray(ded.delay_table(), I32),
@@ -141,6 +177,10 @@ class ScenarioSpec:
             max_clock=jnp.asarray(ded.max_clock, I32),
             commit_chain=jnp.asarray(ded.commit_chain, I32),
             byz_equivocate=eq, byz_silent=silent, byz_forge_qc=forge,
+            adv_sched=jnp.asarray(adv["adv_sched"]),
+            adv_link=jnp.asarray(adv["adv_link"]),
+            adv_group=jnp.asarray(adv["adv_group"]),
+            adv_heal=jnp.asarray(adv["adv_heal"]),
         )
 
 
@@ -149,6 +189,7 @@ def default_row(p: SimParams, seed: int | jnp.ndarray = 0) -> ScenarioPlane:
     describe (a fleet of these is bit-identical to a plain static run)."""
     n = p.n_nodes
     z = jnp.zeros((n,), jnp.bool_)
+    adv = aplane.default_rows(p)
     return ScenarioPlane(
         seed=jnp.asarray(seed).astype(jnp.uint32),
         delay_table=jnp.asarray(p.delay_table(), I32),
@@ -156,6 +197,10 @@ def default_row(p: SimParams, seed: int | jnp.ndarray = 0) -> ScenarioPlane:
         max_clock=jnp.asarray(p.max_clock, I32),
         commit_chain=jnp.asarray(p.commit_chain, I32),
         byz_equivocate=z, byz_silent=z, byz_forge_qc=z,
+        adv_sched=jnp.asarray(adv["adv_sched"]),
+        adv_link=jnp.asarray(adv["adv_link"]),
+        adv_group=jnp.asarray(adv["adv_group"]),
+        adv_heal=jnp.asarray(adv["adv_heal"]),
     )
 
 
@@ -204,6 +249,10 @@ def init_slot(p: SimParams, row: ScenarioPlane, engine=None):
         drop_u32=jnp.asarray(row.drop_u32, jnp.uint32),
         sc_delay=jnp.asarray(row.delay_table, I32),
         sc_commit=jnp.reshape(jnp.asarray(row.commit_chain, I32), (1,)),
+        adv_sched=jnp.asarray(row.adv_sched, I32),
+        adv_link=jnp.asarray(row.adv_link, I32),
+        adv_group=jnp.asarray(row.adv_group, I32),
+        adv_heal=jnp.asarray(row.adv_heal, I32),
     )
 
 

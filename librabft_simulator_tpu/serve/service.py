@@ -184,6 +184,16 @@ class ResidentFleet:
         """Queue one scenario; returns its request id."""
         if isinstance(spec, dict):
             spec = sc.ScenarioSpec.from_dict(spec)
+        # Params-dependent attack validation happens HERE, not at
+        # admission: ScenarioSpec's constructor can only grammar-check
+        # its attack (it has no params), so a program that violates THIS
+        # fleet's contract — too many windows for adv_windows, a target
+        # id >= n_nodes, a wrong-sized link matrix, an attack on an
+        # adversary=False base — must be rejected as a single bad
+        # request now, while the queue is untouched.  Deferred to
+        # _admit's plane_row it would raise mid-serve-loop with requests
+        # already popped/activated and kill the whole resident fleet.
+        spec.adv_rows(self.p)
         with self._qlock:
             if request_id is not None:
                 rid = request_id
@@ -393,6 +403,15 @@ class ResidentFleet:
         p = self.p
         eq, silent, forge = req.spec.byz_masks(p)
         byz_any = (np.asarray(eq) | np.asarray(silent) | np.asarray(forge))
+        prog = req.spec.attack_program()
+        if prog is not None:
+            # Nodes a windowed Byzantine behavior can activate are not
+            # honest referees: exclude them from the safety check exactly
+            # like the static masks.
+            from ..adversary import dsl as adsl
+
+            tgts = [t for t in adsl.byz_targets(prog) if t < p.n_nodes]
+            byz_any = byz_any | np.isin(np.arange(p.n_nodes), tgts)
         st1 = jax.tree.map(lambda x: np.asarray(x)[None], row)
         safe = bool(byzantine.check_safety_reference(
             st1, honest_mask=~byz_any)[0])
@@ -409,6 +428,24 @@ class ResidentFleet:
             "safe": safe,
             "ttfc_s": req.ttfc_s(),
         }
+        if p.watchdog:
+            # Per-request watchdog verdict (telemetry/stream.py WD_SLOTS):
+            # the slot's in-graph trip counters — the safety/liveness
+            # referee for each admitted (possibly adversarial) scenario;
+            # fleet_watch --serve renders these per egressed request.
+            wd = np.asarray(row.wd).reshape(-1)
+            trips = {name: int(wd[tstream.WD_SLOTS.index(name)])
+                     for name in tstream.WD_DETECTORS}
+            out["watchdog"] = dict(
+                trips,
+                safety_ok=(trips["safety_conflict"] == 0
+                           and trips["round_regress"] == 0),
+                liveness_ok=trips["stall"] == 0)
+        if prog is not None:
+            # The decoded attack program rides the result row — the
+            # counterexample-reporting contract (what exactly was this
+            # slot subjected to, independent of the request file).
+            out["attack"] = prog.host_plane(p).describe()
         if p.telemetry:
             from ..telemetry import report as tel_report
 

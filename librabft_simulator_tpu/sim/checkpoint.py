@@ -58,6 +58,16 @@ def _sc_default(p: SimParams, field: str, leaf) -> np.ndarray:
     return np.broadcast_to(row, leaf.shape).copy()
 
 
+#: Adversary-plane leaves (round 17): a checkpoint that predates the
+#: plane (missing keys), or whose plane was OFF while the load params arm
+#: it, restores the INERT program — all-zero rows are the no-attack
+#: schedule by construction (adversary/plane.py), which is exactly what
+#: those params were simulating.  The reverse direction (an armed plane
+#: loaded onto off/resized params) REFUSES: the rows are per-slot attack
+#: data, not derivable from params — see the shape-mismatch branch.
+_ADV_FIELDS = ("adv_sched", "adv_link", "adv_group", "adv_heal")
+
+
 def save(path: str, state: SimState) -> None:
     arrays, _ = _flatten_with_paths(state)
     np.savez_compressed(path, **arrays)
@@ -126,6 +136,12 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
                 # engine would have done — see tests/test_checkpoint.py.
                 leaves.append(_sc_default(p, field, leaf))
                 continue
+            if field in _ADV_FIELDS:
+                # Round 17's adversary plane: pre-plane checkpoints
+                # restore the inert (all-zero) program — bit-identical
+                # to what the adversary-free engine would have done.
+                leaves.append(np.zeros(leaf.shape, leaf.dtype))
+                continue
             raise KeyError(f"checkpoint missing leaf {key}")
         arr = data[key]
         if arr.shape != leaf.shape:
@@ -153,6 +169,17 @@ def load(path: str, p: SimParams, like: SimState | None = None) -> SimState:
                 # is the operator's cue that per-slot scenarios were
                 # dropped.
                 leaves.append(_sc_default(p, field, leaf))
+                continue
+            if field in _ADV_FIELDS and arr.size == 0:
+                # Adversary toggled ON between save and resume (the
+                # saved leaf is zero-width): arm the inert program —
+                # exactly what the adversary-free run was simulating.
+                # Any OTHER mismatch (adversary-on -> off, an
+                # adv_windows resize) falls through to the ValueError:
+                # the plane rows are per-slot attack DATA, not derivable
+                # from params, and zero-filling them would silently
+                # report an attacked run as attack-free.
+                leaves.append(np.zeros(leaf.shape, leaf.dtype))
                 continue
             raise ValueError(f"{key}: shape {arr.shape} != {leaf.shape}")
         leaves.append(arr.astype(leaf.dtype))

@@ -67,9 +67,15 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from ..core import data_sync, node as node_ops, packing, store as store_ops
+from ..adversary import plane as aplane
+from ..core import config, data_sync, node as node_ops, packing, \
+    store as store_ops
 from .simulator import _forged_qc_payload
 from ..core.types import (
+    adv_group_init,
+    adv_heal_init,
+    adv_link_init,
+    adv_sched_init,
     KIND_NOTIFY,
     KIND_REQUEST,
     KIND_RESPONSE,
@@ -164,6 +170,12 @@ class PSimState:
     # zero-width when off, read-only config when on — see SimState.
     sc_delay: jnp.ndarray   # [T] int32 delay table row ([0] when off)
     sc_commit: jnp.ndarray  # [1] int32 commit-chain selector ([0] when off)
+    # Adversary plane (SimParams.adversary; adversary/): zero-width when
+    # off, read-only per-slot attack config when on — see SimState.
+    adv_sched: jnp.ndarray  # [W, ADV_FIELDS] int32 ([0, F] when off)
+    adv_link: jnp.ndarray   # [n, n] int32 ([0, 0] when off)
+    adv_group: jnp.ndarray  # [n] int32 ([0] when off)
+    adv_heal: jnp.ndarray   # [1] int32 ([0] when off)
 
 
 @struct.dataclass
@@ -206,6 +218,10 @@ class PackedPSimState:
     wd: jnp.ndarray
     sc_delay: jnp.ndarray
     sc_commit: jnp.ndarray
+    adv_sched: jnp.ndarray
+    adv_link: jnp.ndarray
+    adv_group: jnp.ndarray
+    adv_heal: jnp.ndarray
 
 
 _PSIM_COMMON = packing._common_fields(PSimState)
@@ -333,6 +349,10 @@ def init_state(p: SimParams, seed, weights=None, byz_equivocate=None,
         wd=tstream.init_wd(p),
         sc_delay=sc_delay_init(p),
         sc_commit=sc_commit_init(p),
+        adv_sched=adv_sched_init(p),
+        adv_link=adv_link_init(p),
+        adv_group=adv_group_init(p),
+        adv_heal=adv_heal_init(p),
     )
 
 
@@ -379,6 +399,17 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         d_min = jnp.maximum(jnp.min(st.sc_delay), 1)
     else:
         pp = p
+    # Adversary network plane: every link's latency exceeds the drawn
+    # table delay by at least the minimum off-diagonal adv_link entry, so
+    # the Chandy–Misra horizon soundly TIGHTENS by exactly that amount —
+    # per-link lookahead instead of the global table bound (wider windows
+    # on delay-skewed matrices).  ``d_min`` itself stays the table bound:
+    # it also clamps the per-message draws below, where folding the link
+    # extra in would inflate the base draws and change trajectories.
+    if p.adversary:
+        d_hz = d_min + aplane.link_lookahead(st.adv_link, n)
+    else:
+        d_hz = d_min
 
     # ---- Window bookkeeping: per-node earliest times, global horizon.
     # The horizon must be GLOBAL (t_min + d_min), not per-node: with
@@ -397,7 +428,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
     halt = st.halted | (t_min > st.max_clock)
     live = ~halt
     clock = jnp.maximum(st.clock, jnp.minimum(t_min, NEVER - 1))
-    hz = jnp.minimum(t_min, NEVER - d_min) + d_min  # scalar
+    hz = jnp.minimum(t_min, NEVER - d_hz) + d_hz  # scalar
     qualify = live & (t_ev < hz) & (t_ev <= st.max_clock)
 
     # ---- Lane compaction: the A earliest qualifying nodes (ties by index).
@@ -454,6 +485,27 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         do_update = act & (is_tm | is_notify | is_response)
         lclk = t_l - lane_startup  # each lane handles its own event time
 
+        # ---- Adversary plane decode, per lane (adversary/plane.py):
+        # windowed behaviors OR-composed onto the static masks.  Keys are
+        # each lane's OWN event time and pre-handler epoch (both
+        # window-composition-invariant), and the instance event count at
+        # WINDOW start (st.n_events — MODE_EVENTS bounds are evaluated at
+        # window granularity here; the serial engine is the per-event
+        # reference for that mode).  Off: compiled out entirely.
+        if p.adversary:
+            ep_pre = g_store.epoch_id  # [A] pre-handler epochs
+            adv_act = jax.vmap(
+                lambda t, ep: aplane.active_windows(
+                    st.adv_sched, t, st.n_events, ep))(t_l, ep_pre)
+            adv_eq, adv_sil, adv_forge = jax.vmap(
+                lambda ac, i: aplane.node_masks(st.adv_sched, ac, i))(
+                adv_act, sel)
+            l_eq = lane_equiv | adv_eq
+            l_sil = lane_silent | adv_sil
+            l_forge = lane_forge | adv_forge
+        else:
+            l_eq, l_sil, l_forge = lane_equiv, lane_silent, lane_forge
+
         def per_lane(i, s_a, pm_a, nx_a, cx_a, pay_row, lc, ho_row, ho_ep):
             a = sel[i]
             pay_in = unpack_payload(p, pay_row)
@@ -472,7 +524,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
             nx_f = store_ops._sel(do_update[i], nx_u, nx_in)
             cx_f = store_ops._sel(do_update[i], cx_u, cx_in)
             notif = data_sync.create_notification(pp, s_f, a)
-            notif = store_ops._sel(lane_forge[i],
+            notif = store_ops._sel(l_forge[i],
                                    _forged_qc_payload(pp, s_f, a, notif), notif)
             request = data_sync.create_request(pp, s_f)
             response = data_sync.handle_request(pp, s_f, a, pay_in, notif=notif)
@@ -508,15 +560,15 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
             g_hop, g_hoe)
 
         # ---- Outgoing candidates: [A lanes, 2n+1 candidates].
-        want_sync_req = is_notify & should_sync & ~lane_silent
-        want_response = is_request & ~lane_silent
+        want_sync_req = is_notify & should_sync & ~l_sil
+        want_response = is_request & ~l_sil
         cand0_want = want_sync_req | want_response
         cand0_kind = jnp.where(want_response, KIND_RESPONSE, KIND_REQUEST)
         cand0_recv = jnp.clip(sender, 0, n - 1)
         send_mask = (actions.send_mask & others_l & do_update[:, None]
-                     & ~lane_silent[:, None])
+                     & ~l_sil[:, None])
         query_mask = ((actions.should_query_all & do_update
-                       & ~lane_silent)[:, None] & others_l)
+                       & ~l_sil)[:, None] & others_l)
 
         want = jnp.concatenate([cand0_want[:, None], send_mask, query_mask],
                                axis=1)
@@ -531,7 +583,7 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
             jnp.full((A, n), KIND_REQUEST, I32),
         ], axis=1)
         upper = (jnp.arange(n) * 2 >= n)[None, :]
-        eq_sel = jnp.where(lane_equiv[:, None] & upper, 1, 0)
+        eq_sel = jnp.where(l_eq[:, None] & upper, 1, 0)
         pay_sel = jnp.concatenate([
             jnp.where(want_response, 3, 2)[:, None],
             eq_sel,
@@ -553,6 +605,22 @@ def step(p: SimParams, delay_table, dur_table, d_min: int, st: PSimState):
         delays = jnp.maximum(
             delay_table[(u_delay >> (32 - TABLE_BITS)).astype(I32)], d_min)
         dropped = want & (u_drop < st.drop_u32)
+        if p.adversary:
+            # Network plane: per-link + windowed targeted/leader delay
+            # extras on top of each drawn latency (the extras are what
+            # the d_hz horizon tightening above is backed by), and the
+            # partition cut on crossing messages sent before heal.
+            leader = config.leader_of_round(st.weights, g_pm.active_round)
+            extra = jax.vmap(
+                lambda ac, rv, ld: aplane.delay_extra(
+                    st.adv_sched, ac, rv, ld))(adv_act, recvs, leader)
+            delays = (delays
+                      + jnp.clip(st.adv_link[sel[:, None], recvs], 0,
+                                 aplane.DELAY_CAP)
+                      + extra)
+            cut = ((st.adv_group[sel][:, None] != st.adv_group[recvs])
+                   & (t_l[:, None] < st.adv_heal[0]))
+            dropped = dropped | (want & cut)
         arrive = t_l[:, None] + delays  # lane's event time + latency
         go = want & ~dropped
 

@@ -28,8 +28,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import data_sync, node as node_ops, packing, store as store_ops
+from ..adversary import plane as aplane
+from ..core import config, data_sync, node as node_ops, packing, \
+    store as store_ops
 from ..core.types import (
+    adv_group_init,
+    adv_heal_init,
+    adv_link_init,
+    adv_sched_init,
     KIND_NOTIFY,
     KIND_REQUEST,
     KIND_RESPONSE,
@@ -133,6 +139,10 @@ def init_state(p: SimParams, seed: int | jnp.ndarray, weights=None,
         wd=tstream.init_wd(p),
         sc_delay=sc_delay_init(p),
         sc_commit=sc_commit_init(p),
+        adv_sched=adv_sched_init(p),
+        adv_link=adv_link_init(p),
+        adv_group=adv_group_init(p),
+        adv_heal=adv_heal_init(p),
     )
 
 
@@ -249,6 +259,25 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         cx_a = _node_slice(st.ctx, a)
     local_clock = clock - st.startup[a]
 
+    # ---- Adversary plane decode (adversary/plane.py): windowed behavior
+    # activations for the handled node, OR-composed onto the static byz_*
+    # masks.  Keys are the event time, the instance's PRE-event count,
+    # and the handled node's PRE-handler epoch — all values the oracle
+    # replays exactly.  Off (default): compiled out entirely, and the
+    # byz_* reads below are the exact historical graph.
+    if p.adversary:
+        adv_act = aplane.active_windows(st.adv_sched, clock, st.n_events,
+                                        s_a.epoch_id)
+        adv_eq, adv_sil, adv_forge = aplane.node_masks(st.adv_sched,
+                                                       adv_act, a)
+        eqv_a = st.byz_equivocate[a] | adv_eq
+        silent_a = st.byz_silent[a] | adv_sil
+        forge_a = st.byz_forge_qc[a] | adv_forge
+    else:
+        eqv_a = st.byz_equivocate[a]
+        silent_a = st.byz_silent[a]
+        forge_a = st.byz_forge_qc[a]
+
     # ---- Handlers, masked by kind.
     is_notify = live & ~is_timer & (kind == KIND_NOTIFY)
     is_request = live & ~is_timer & (kind == KIND_REQUEST)
@@ -294,7 +323,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
 
     # ---- Outgoing messages.
     notif = data_sync.create_notification(pp, s_f, a)
-    notif = store_ops._sel(st.byz_forge_qc[a],
+    notif = store_ops._sel(forge_a,
                            _forged_qc_payload(pp, s_f, a, notif), notif)
     notif_b = _equivocated_payload(pp, s_f, a, notif)
     request = data_sync.create_request(pp, s_f)
@@ -329,7 +358,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
         pack_payload(request), resp_row,
     ])
 
-    silent = st.byz_silent[a]
+    silent = silent_a
     others = jnp.arange(n) != a
     # Candidate order fixes the stamp sequence: [sync-request or response] then
     # (timer stamp) then notifications then query-all requests.
@@ -343,7 +372,7 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     send_mask = actions.send_mask & others & do_update & ~silent
     # Equivocators send the conflicting proposal to the upper index half.
     upper = (jnp.arange(n) * 2 >= n)
-    notif_sel = jnp.where(st.byz_equivocate[a] & upper, _i32(1), _i32(0))
+    notif_sel = jnp.where(eqv_a & upper, _i32(1), _i32(0))
     query_mask = jnp.where(actions.should_query_all & do_update & ~silent, others, False)
 
     if p.shuffle_receivers:
@@ -382,6 +411,22 @@ def step(p: SimParams, delay_table, dur_table, st: SimState) -> SimState:
     u_drop = jax.vmap(lambda c: H.mix32(c, jnp.uint32(0x632BE59B)))(u_delay)
     delays = delay_table[(u_delay >> (32 - TABLE_BITS)).astype(I32)]
     dropped = want & (u_drop < st.drop_u32)
+    if p.adversary:
+        # Network plane: per-link extra delay + windowed targeted /
+        # leader-targeted delay on top of the drawn latency, and the
+        # partition cut — a crossing message sent before the heal time
+        # is dropped (counted with the rng drops).  Extras only ADD and
+        # cuts only REMOVE, so the lane engine's lookahead bound is
+        # unaffected; the serial engine has no lookahead to protect.
+        recv_c = jnp.clip(recvs, 0, n - 1)
+        leader = config.leader_of_round(st.weights, pm_f.active_round)
+        delays = (delays
+                  + jnp.clip(st.adv_link[a, recv_c], 0, aplane.DELAY_CAP)
+                  + aplane.delay_extra(st.adv_sched, adv_act, recv_c,
+                                       leader))
+        cut = ((st.adv_group[a] != st.adv_group[recv_c])
+               & (clock < st.adv_heal[0]))
+        dropped = dropped | (want & cut)
     arrive = clock + delays
 
     # Free-slot assignment.

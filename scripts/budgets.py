@@ -43,6 +43,16 @@ toolchains.
   traced per-slot delay-table reads and the 2-vs-3-chain commit
   selects, ~7% headroom like the others.  Scenario OFF stays under
   ``census_off`` exactly (zero-width leaves compile out).
+* ``census_adversary`` 1080 / ``census_adversary_lane`` 1200 — the
+  adversary-plane graphs (SimParams.adversary; adversary/):
+  tpu_shape_adversary 1009 vs 1000 off (+9 fusion sites for the windowed
+  attack-schedule decode, per-link delay adds, and partition cuts —
+  KERNEL_CENSUS_r17.json) and the LANE engine's adversary window step
+  1121 (the per-link horizon derivation rides existing fusions; the
+  lane flavor had no prior census — this is its first recorded value),
+  each + ~7% headroom.  Adversary OFF stays under ``census_off``
+  exactly (zero-width leaves compile out; the graph audit's R6
+  adversary arm is the static twin).
 * ``census_k4`` 1090 / ``census_k16`` 1090 — the K-event macro-step
   programs (SimParams.macro_k; sim/simulator.py macro_step): 1018 top
   fusions at BOTH K=4 and K=16 — the rolled inner scan's body is one
@@ -74,6 +84,8 @@ BUDGETS = {
     "census_k4": 1090,
     "census_k16": 1090,
     "census_scenario": 1140,
+    "census_adversary": 1080,
+    "census_adversary_lane": 1200,
     "tier1_min_dots": 39,
 }
 
@@ -84,22 +96,25 @@ BUDGETS = {
 #: any drift (a state leaf added/removed, a donate_argnums change, a
 #: jit that silently stopped donating) is a gated diff, reviewed next to
 #: the dedupe_buffers call-site audit — never a silent rebaseline.
-#: Provenance: engine states flatten to 110 leaves (PSimState 108); the
-#: serial/lane runners donate exactly the state argument (tables and the
-#: lane lookahead scalar are host-reused), the sharded runner's ONLY
-#: input is the donated state, install_rows donates the resident state
-#: but never the admission mask/donor, and the checkify sanitizer build
-#: donates NOTHING (callers hand it externally-held states with no
-#: dedupe obligation).
+#: Provenance: engine states flatten to 114 leaves (PSimState 112) since
+#: round 17 added the four adversary-plane leaves
+#: (adv_sched/adv_link/adv_group/adv_heal — zero-width when the plane is
+#: off, donated like every other state leaf; the round-16 pins were
+#: 110/108); the serial/lane runners donate exactly the state argument
+#: (tables and the lane lookahead scalar are host-reused), the sharded
+#: runner's ONLY input is the donated state, install_rows donates the
+#: resident state but never the admission mask/donor, and the checkify
+#: sanitizer build donates NOTHING (callers hand it externally-held
+#: states with no dedupe obligation).
 DONATION = {
-    "serial/run": 110,
-    "serial/digest": 110,
-    "serial/telemetry": 110,
-    "serial/scenario": 110,
-    "lane/digest": 108,
-    "sharded/digest": 110,
-    "sharded/scenario": 110,
-    "serve/install": 110,
+    "serial/run": 114,
+    "serial/digest": 114,
+    "serial/telemetry": 114,
+    "serial/scenario": 114,
+    "lane/digest": 112,
+    "sharded/digest": 114,
+    "sharded/scenario": 114,
+    "serve/install": 114,
     "sanitize/serial": 0,
 }
 
@@ -112,6 +127,8 @@ SH_VARS = {
     "census_k4": "K4_CENSUS_BUDGET",
     "census_k16": "K16_CENSUS_BUDGET",
     "census_scenario": "SCENARIO_CENSUS_BUDGET",
+    "census_adversary": "ADVERSARY_CENSUS_BUDGET",
+    "census_adversary_lane": "ADVERSARY_LANE_CENSUS_BUDGET",
     "tier1_min_dots": "TIER1_MIN_DOTS",
 }
 

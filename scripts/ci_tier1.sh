@@ -44,8 +44,8 @@ echo "=== static audit v2, fast families (jaxpr R1-R6, source S1-S4, donation D1
 # exactly the chunk executables the HLO pass compiles; cold it would
 # blow this stage's budget).  The artifact is always written.
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
-    --assert-clean --no-hlo --out GRAPH_AUDIT_r16.json; then
-    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r16.json)" >&2
+    --assert-clean --no-hlo --out GRAPH_AUDIT_r17.json; then
+    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r17.json)" >&2
     exit 1
 fi
 
@@ -89,8 +89,8 @@ echo "=== static audit v2, compiled-HLO leg (scatter class + provenance, digest-
 # stage already passed; the HLO artifact lands beside the main one.
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
     --assert-clean --engines "" --no-sharded --no-source --no-donation \
-    --no-concurrency --out GRAPH_AUDIT_r16_hlo.json; then
-    echo "FAIL: compiled-HLO audit not clean (see GRAPH_AUDIT_r16_hlo.json)" >&2
+    --no-concurrency --out GRAPH_AUDIT_r17_hlo.json; then
+    echo "FAIL: compiled-HLO audit not clean (see GRAPH_AUDIT_r17_hlo.json)" >&2
     exit 1
 fi
 
@@ -155,6 +155,11 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_serve.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 serve_rc=$?
 
+echo "=== adversary engine referees (tests/test_adversary.py in FULL: off/inert identity, static-mask window reproduction serial+lane+sharded, oracle parity under composed attacks, per-link lane horizon, attacks-as-requests one-compile pin) ==="
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_adversary.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+adv_rc=$?
+
 echo "=== AOT store referees (tests/test_aot.py in FULL — the store-backed round trips are slow-marked out of the 870 s suite because their export fixture deliberately pays ~4 fresh compiles) ==="
 timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_aot.py -q -p no:cacheprovider -p no:xdist -p no:randomly
@@ -171,7 +176,7 @@ timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest \
     -p no:xdist -p no:randomly
 dist_rc=$?
 
-echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro / ${SCENARIO_CENSUS_BUDGET} scenario) ==="
+echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro / ${SCENARIO_CENSUS_BUDGET} scenario / ${ADVERSARY_CENSUS_BUDGET} adversary / ${ADVERSARY_LANE_CENSUS_BUDGET} adversary-lane) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-max "${CENSUS_BUDGET}" \
     --assert-telemetry-max "${TELEMETRY_CENSUS_BUDGET}" \
@@ -179,7 +184,9 @@ JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-sharded-max "${SHARDED_CENSUS_BUDGET}" \
     --assert-k4-max "${K4_CENSUS_BUDGET}" \
     --assert-k16-max "${K16_CENSUS_BUDGET}" \
-    --assert-scenario-max "${SCENARIO_CENSUS_BUDGET}"
+    --assert-scenario-max "${SCENARIO_CENSUS_BUDGET}" \
+    --assert-adversary-max "${ADVERSARY_CENSUS_BUDGET}" \
+    --assert-adversary-lane-max "${ADVERSARY_LANE_CENSUS_BUDGET}"
 census_rc=$?
 
 tests_ok=0
@@ -202,6 +209,10 @@ if [ "$parity_rc" -ne 0 ]; then
 fi
 if [ "$serve_rc" -ne 0 ]; then
     echo "FAIL: resident fleet service referees rc=$serve_rc" >&2
+    exit 1
+fi
+if [ "$adv_rc" -ne 0 ]; then
+    echo "FAIL: adversary engine referees rc=$adv_rc" >&2
     exit 1
 fi
 if [ "$aot_rc" -ne 0 ]; then

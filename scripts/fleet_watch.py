@@ -245,6 +245,17 @@ class _ServeView:
                           f"commits={res.get('commits')} "
                           f"safe={res.get('safe')} "
                           f"latency_s={obj.get('latency_s')}")
+                wd = res.get("watchdog")
+                if wd:
+                    # Per-request watchdog referee (serve/_result_of):
+                    # in-graph trip counts = the safety/liveness verdict
+                    # for this admitted (possibly adversarial) scenario.
+                    trips = ",".join(
+                        f"{k}={v}" for k, v in wd.items()
+                        if k not in ("safety_ok", "liveness_ok") and v)
+                    detail += (f" wd[safety={'OK' if wd.get('safety_ok') else 'TRIPPED'}"
+                               f" liveness={'OK' if wd.get('liveness_ok') else 'STALLED'}"
+                               + (f" {trips}" if trips else "") + "]")
             print(f"{obj.get('t_s', 0):>8.2f} {obj.get('event', '?'):>11} "
                   f"{str(obj.get('id')):>10} "
                   f"{str(obj.get('slot', '-')):>5} "
@@ -285,6 +296,17 @@ def show_serve(path: str, out=None) -> int:
     print(f"# pending={last.get('pending')} active={last.get('active')} "
           f"egressed={last.get('egressed')} of {meta.get('slots')} slots",
           file=out)
+    # Watchdog referee roll-up: per-request safety/liveness verdicts over
+    # every egressed scenario that carried the [WD] trip counters.
+    verdicts = [e["result"]["watchdog"] for e in events
+                if e.get("event") == "egressed"
+                and (e.get("result") or {}).get("watchdog")]
+    if verdicts:
+        bad_safe = sum(1 for w in verdicts if not w.get("safety_ok"))
+        stalled = sum(1 for w in verdicts if not w.get("liveness_ok"))
+        print(f"# watchdog: {len(verdicts)} refereed requests, "
+              f"{bad_safe} safety-tripped, {stalled} liveness-stalled",
+              file=out)
     return 0
 
 

@@ -22,10 +22,19 @@ Usage: python scripts/fuzz_parity.py [minutes]   # default 30
     FUZZ_PACKED=1 python scripts/fuzz_parity.py 10   # packed-plane engine
     FUZZ_MACRO_K=1 python scripts/fuzz_parity.py 10  # randomize macro_k
     FUZZ_SCENARIO=1 python scripts/fuzz_parity.py 10 # heterogeneous fleets
+    FUZZ_ADVERSARY=1 python scripts/fuzz_parity.py 10 # attack programs
 Writes FUZZ_PARITY_r05.json (FUZZ_PARITY_r06_packed.json under
 FUZZ_PACKED=1; FUZZ_PARITY_r11_macro.json under FUZZ_MACRO_K=1;
-FUZZ_PARITY_r14_scenario.json under FUZZ_SCENARIO=1)
+FUZZ_PARITY_r14_scenario.json under FUZZ_SCENARIO=1;
+FUZZ_PARITY_r17_adversary.json under FUZZ_ADVERSARY=1)
 {trials, structural_shapes, macro_trials, failures[]}.
+
+FUZZ_ADVERSARY=1 is the adversary-engine campaign (adversary/): per-trial
+randomized attack programs — windowed equivocation/silence/forged QCs,
+targeted + leader-targeted delay, per-link extra-delay matrices,
+partitions-with-heal — installed as plane data on the adversary-armed
+serial engine and pinned per event against OracleSim(attack=...); the
+minidump records the DECODED program (the counterexample reporter).
 
 FUZZ_SCENARIO=1 is the serving-regime campaign: every trial builds a
 small fleet whose slots each draw an INDEPENDENT random scenario row
@@ -107,6 +116,25 @@ SCENARIO_STRUCTURAL = [
     dict(n_nodes=3),
     dict(n_nodes=4),
     dict(n_nodes=5, window=8, chain_k=2, commit_log=16),
+]
+
+# FUZZ_ADVERSARY=1: adversary-engine campaign (adversary/) — every trial
+# draws a RANDOM attack program (windowed equivocation / targeted silence
+# / forged QCs / targeted + leader-targeted delay, an optional per-link
+# extra-delay matrix, an optional partition-with-heal), installs it on
+# the adversary-armed serial engine, and checks the FULL oracle-parity
+# invariant set against OracleSim(attack=...) — the per-event mirror of
+# the window decode, link delays, and partition cuts.  The plane is
+# per-slot DATA, so the whole campaign rides a couple of structural
+# compiles.  Minidumps record the DECODED program (HostPlane.describe),
+# the counterexample-reporting contract.  LIBRABFT_ADV_WINDOWS sets the
+# plane's window capacity W (a compile key; default 4).
+ADVERSARY = xops._bool_env("FUZZ_ADVERSARY") or False
+ADV_WINDOWS = int(os.environ.get("LIBRABFT_ADV_WINDOWS", "") or 4)
+ADV_STRUCTURAL = [
+    dict(n_nodes=4),
+    dict(n_nodes=5, window=8, chain_k=2, commit_log=16),
+    dict(n_nodes=7, queue_cap=48),
 ]
 
 DELAYS = [
@@ -209,6 +237,31 @@ def scenario_trial(base_kw: dict, rng) -> tuple[list, dict]:
     return specs, slot_errs
 
 
+def adversary_trial(base_kw: dict, rng) -> tuple[dict, dict, list[str]]:
+    """One randomized attack-program trial: engine (plane installed) vs
+    oracle (HostPlane mirror).  Returns (runtime axes, program dict with
+    its decoded form, errors)."""
+    from librabft_simulator_tpu.adversary import dsl as adsl
+
+    runtime = dict(rng.choice(DELAYS))
+    runtime["drop_prob"] = rng.choice([0.0, 0.0, 0.02, 0.05])
+    runtime["max_clock"] = rng.choice([400, 800, 1500])
+    p = SimParams(**base_kw, **runtime, packed=PACKED, adversary=True,
+                  adv_windows=ADV_WINDOWS)
+    seed = rng.randrange(2**31)
+    prog = adsl.sample_program(p, rng, horizon=runtime["max_clock"])
+    st = S.init_state(p, seed)
+    st = prog.install(p, st)
+    st = S.run_to_completion(p, st)
+    orc = OracleSim(p, seed, attack=prog).run()
+    byz_any = np.isin(np.arange(p.n_nodes),
+                      sorted(adsl.byz_targets(prog)))
+    errs = compare_oracle(p, st, orc, byz_any)
+    info = dict(seed=seed, attack=prog.to_dict(),
+                decoded=prog.host_plane(p).describe())
+    return runtime, info, errs
+
+
 def write_minidump(p: SimParams, seed: int, structural: dict, runtime: dict,
                    byz, errs: list[str], index: int) -> str:
     """First-divergence minidump for a failing trial.
@@ -260,7 +313,44 @@ def main() -> int:
     macro_trials: dict = {}
     shapes_used = set()
     failures = []
+    adv_stats = {"partition": 0, "link": 0, "windows": 0}
     while time.time() < deadline:
+        if ADVERSARY:
+            sk = rng.randrange(len(ADV_STRUCTURAL))
+            structural = ADV_STRUCTURAL[sk]
+            runtime, info, errs = adversary_trial(structural, rng)
+            trials += 1
+            shapes_used.add((sk, 1))
+            dec = info["decoded"]
+            adv_stats["windows"] += len(dec["windows"])
+            adv_stats["partition"] += int(dec["heal"] != 0
+                                          and len(set(dec["groups"])) > 1)
+            adv_stats["link"] += int(bool(dec["link"])
+                                     and any(any(r) for r in dec["link"]))
+            for w in dec["windows"]:
+                key = "byz_" + w["behavior"]
+                if key in byz_trials:
+                    byz_trials[key] += 1
+            if errs:
+                dump = dict(structural=structural, runtime=runtime,
+                            errors=errs, **info)
+                path = (f"FUZZ_MINIDUMP_ADV_{len(failures):04d}_"
+                        f"seed{info['seed']}.json")
+                with open(path, "w") as f:
+                    json.dump(dump, f, indent=1, default=str)
+                failures.append(dict(structural=structural,
+                                     runtime=runtime, errors=errs,
+                                     attack=info["attack"],
+                                     seed=info["seed"], minidump=path))
+                print(json.dumps(failures[-1]), flush=True)
+            if trials % 10 == 0:
+                print(f"[fuzz] {trials} adversary trials "
+                      f"({adv_stats['windows']} windows, "
+                      f"{adv_stats['partition']} partitions, "
+                      f"{adv_stats['link']} link matrices), "
+                      f"{len(failures)} failures", file=sys.stderr,
+                      flush=True)
+            continue
         if SCENARIO:
             # Heterogeneous-fleet mode: the structural axis is SHAPES
             # only (delay/commit-chain/byz/drop are per-slot data — the
@@ -331,13 +421,16 @@ def main() -> int:
             print(f"[fuzz] {trials} trials, {len(shapes_used)} shapes, "
                   f"{len(failures)} failures", file=sys.stderr, flush=True)
     out = dict(trials=trials, byz_trials=byz_trials, packed=PACKED,
-               macro=MACRO, scenario=SCENARIO,
+               macro=MACRO, scenario=SCENARIO, adversary=ADVERSARY,
                scenario_slots=(SCENARIO_SLOTS if SCENARIO else 0),
                slots_checked=(trials * SCENARIO_SLOTS if SCENARIO else 0),
+               adversary_stats=(dict(adv_stats, adv_windows=ADV_WINDOWS)
+                                if ADVERSARY else None),
                macro_trials={str(k): v for k, v in
                              sorted(macro_trials.items())},
                structural_shapes=len(shapes_used), failures=failures)
-    artifact = ("FUZZ_PARITY_r14_scenario.json" if SCENARIO
+    artifact = ("FUZZ_PARITY_r17_adversary.json" if ADVERSARY
+                else "FUZZ_PARITY_r14_scenario.json" if SCENARIO
                 else "FUZZ_PARITY_r11_macro.json" if MACRO
                 else "FUZZ_PARITY_r06_packed.json" if PACKED
                 else "FUZZ_PARITY_r05.json")

@@ -160,6 +160,26 @@ def census_step(p: SimParams, batch: int) -> dict:
     return out
 
 
+def census_lane(p: SimParams, batch: int) -> dict:
+    """Lower + compile the jitted vmapped LANE-engine window step; count
+    HLO ops — the parallel engine's flavor of :func:`census_step`
+    (introduced for the adversary plane, whose per-link horizon
+    derivation lives in this engine's graph).  The tables and the
+    conservative lookahead are bound exactly as the engine's own
+    ``make_run_fn`` binds them."""
+    from librabft_simulator_tpu.sim import parallel_sim as PS
+
+    st = PS.init_batch(p, np.arange(batch, dtype=np.uint32))
+    if p.packed:
+        st = PS.pack_pstate(p, st)
+    dt = jnp.asarray(p.delay_table())
+    du = jnp.asarray(p.duration_table())
+    f = jax.jit(jax.vmap(
+        functools.partial(PS.step, p, dt, du, PS.d_min_of(p))))
+    compiled = f.lower(st).compile()
+    return hlo_counts(compiled.as_text())
+
+
 def census_sharded(p: SimParams, batch: int, dp: int) -> dict:
     """Per-shard census of the dp-fleet runtime (parallel/sharded.py).
 
@@ -236,6 +256,15 @@ MODES = {
     # selects' fusion cost is gated here, not guessed.
     "tpu_shape_scenario": dict(packed=True, dense_writes="dense",
                                gate_handlers=True, scenario=True),
+    # Adversary plane (SimParams.adversary; adversary/): the windowed
+    # attack-schedule decode, per-link delay adds, and partition cuts.
+    # Adversary OFF must leave tpu_shape untouched (the --assert-max
+    # gate — zero-width leaves compile out; the graph audit's R6
+    # adversary arm is the static twin); ON pays its own budget
+    # (--assert-adversary-max).  The lane flavor is censused separately
+    # below (census_lane) under --assert-adversary-lane-max.
+    "tpu_shape_adversary": dict(packed=True, dense_writes="dense",
+                                gate_handlers=True, adversary=True),
 }
 
 
@@ -269,6 +298,16 @@ def main() -> int:
                          "count exceeds this budget (CI gate; the "
                          "scenario-plane per-slot select graph — "
                          "scenario OFF is covered by --assert-max)")
+    ap.add_argument("--assert-adversary-max", type=int, default=None,
+                    help="exit nonzero if the tpu_shape_adversary fusion "
+                         "count exceeds this budget (CI gate; the "
+                         "attack-schedule/link/partition decode graph — "
+                         "adversary OFF is covered by --assert-max)")
+    ap.add_argument("--assert-adversary-lane-max", type=int, default=None,
+                    help="exit nonzero if the LANE engine's adversary "
+                         "window-step fusion count exceeds this budget "
+                         "(CI gate; includes the per-link horizon "
+                         "derivation)")
     ap.add_argument("--sharded", action="store_true",
                     help="also census the per-shard dp-fleet program "
                          "(shard_map runner on a 2-shard virtual CPU mesh)")
@@ -305,6 +344,10 @@ def main() -> int:
             args.assert_k16_max = b["census_k16"]
         if args.assert_scenario_max is None:
             args.assert_scenario_max = b["census_scenario"]
+        if args.assert_adversary_max is None:
+            args.assert_adversary_max = b["census_adversary"]
+        if args.assert_adversary_lane_max is None:
+            args.assert_adversary_lane_max = b["census_adversary_lane"]
     if args.assert_sharded_max is not None:
         args.sharded = True
 
@@ -348,6 +391,17 @@ def main() -> int:
               f"whiles={c['whiles']} scatters={c['scatters']}{per_ev}",
               flush=True)
 
+    # Lane-engine adversary flavor: the per-link-horizon graph lives in
+    # the parallel engine, so it gets its own compile + budget.
+    p_lane = dataclasses.replace(base, **MODES["tpu_shape_adversary"])
+    c = census_lane(p_lane, args.batch)
+    out["modes"]["tpu_shape_adversary_lane"] = c
+    print(f"{'tpu_shape_adversary_lane':18s} top_fusions={c['top_fusions']:4d} "
+          f"top_dispatch={c['top_dispatch']:4d} "
+          f"total_fusions={c['total_fusions']:5d} "
+          f"whiles={c['whiles']} scatters={c['scatters']} (lane engine)",
+          flush=True)
+
     if args.sharded:
         p_sh = dataclasses.replace(base, **MODES["tpu_shape"])
         c = census_sharded(p_sh, args.batch, args.sharded_dp)
@@ -386,7 +440,10 @@ def main() -> int:
         return 1
     for kname, budget in (("tpu_shape_k4", args.assert_k4_max),
                           ("tpu_shape_k16", args.assert_k16_max),
-                          ("tpu_shape_scenario", args.assert_scenario_max)):
+                          ("tpu_shape_scenario", args.assert_scenario_max),
+                          ("tpu_shape_adversary", args.assert_adversary_max),
+                          ("tpu_shape_adversary_lane",
+                           args.assert_adversary_lane_max)):
         kc = out["modes"][kname]["top_fusions"]
         if budget is not None and kc > budget:
             print(f"FAIL: {kname} fusion count {kc} exceeds "

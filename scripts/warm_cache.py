@@ -70,10 +70,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", "tests"))
 from fleet_shapes import (  # noqa: E402
-    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_MACRO_SER_KW,
-    FLEET_MACRO_WD_SER_KW, FLEET_SCENARIO_LANE_KW, FLEET_SCENARIO_SER_KW,
-    FLEET_SER_KW, FLEET_WD_LANE_KW, FLEET_WD_SER_KW, SERVE_CHUNK, SERVE_DP,
-    SERVE_SLOTS)
+    FLEET_ADV_LANE_KW, FLEET_ADV_SER_KW, FLEET_ADV_SERVE_KW, FLEET_B,
+    FLEET_CHUNK, FLEET_LANE_KW, FLEET_MACRO_SER_KW, FLEET_MACRO_WD_SER_KW,
+    FLEET_SCENARIO_LANE_KW, FLEET_SCENARIO_SER_KW, FLEET_SER_KW,
+    FLEET_WD_LANE_KW, FLEET_WD_SER_KW, SERVE_CHUNK, SERVE_DP, SERVE_SLOTS)
 
 # Unsharded reference runs of the tier-1 2-shard parity pair, plus the
 # watchdog-armed twins tests/test_stream.py runs (watchdog and its stall
@@ -105,6 +105,16 @@ SHAPES += [
     # the lane-engine scenario parity leg.
     ("serial", FLEET_SCENARIO_SER_KW, SERVE_SLOTS, SERVE_CHUNK),
     ("parallel", FLEET_SCENARIO_LANE_KW, SERVE_SLOTS, SERVE_CHUNK),
+    # Adversary-engine twins (adversary/; tests/test_adversary.py): the
+    # attack-schedule + network planes are a compile key (the adv_*
+    # leaf shapes), but — like the scenario plane — the LAST fork their
+    # family needs: one entry per engine serves every attack program,
+    # link matrix, and partition schedule the referees sweep.  The bare
+    # serial 4-node shape is their OFF twin (the inert/static-mask
+    # identity references run the serial engine at FLEET_LANE_KW).
+    ("serial", FLEET_LANE_KW, None, FLEET_CHUNK),
+    ("serial", FLEET_ADV_SER_KW, None, FLEET_CHUNK),
+    ("parallel", FLEET_ADV_LANE_KW, None, FLEET_CHUNK),
 ]
 
 # Sanitizer (audit/sanitize.py) twins of the micro fleet pair: the
@@ -142,6 +152,11 @@ SHARDED_SHAPES = [
     # + mesh + chunk): one entry serves every scenario config a serve
     # session admits — the executable-count collapse in one line.
     ("serial", FLEET_SCENARIO_SER_KW, SERVE_SLOTS, SERVE_CHUNK, SERVE_DP),
+    # The adversarial resident-service executable (tests/test_adversary's
+    # serve referee): scenario + adversary + watchdog armed — one sharded
+    # entry admits every attack program as a request and referees it with
+    # the in-graph watchdog trip counts.
+    ("serial", FLEET_ADV_SERVE_KW, SERVE_SLOTS, SERVE_CHUNK, SERVE_DP),
 ]
 
 #: Shared child preamble: pin the CPU backend BEFORE the jax import and

@@ -59,3 +59,24 @@ FLEET_SCENARIO_LANE_KW = dict(FLEET_LANE_KW, scenario=True)
 SERVE_SLOTS = 4
 SERVE_CHUNK = 32
 SERVE_DP = 2
+
+# Adversary-engine twins (adversary/; tests/test_adversary.py): the
+# 4-NODE micro shape (f=1 Byzantine windows stay inside the 3f+1
+# tolerance, link matrices are 4x4) with the attack-schedule + network
+# planes armed.  ``adversary`` and ``adv_windows`` are compile keys (the
+# plane's shapes), so the suite's shapes and the warmed executables must
+# match exactly — single-sourced here.  Both engines share the shape;
+# the identity referees additionally run the SERIAL engine at the bare
+# 4-node FLEET_LANE_KW (the off twin), so warm_cache warms that serial
+# flavor too.  The serve referee arms watchdog (the per-request
+# safety/liveness verdicts fleet_watch --serve shows) + scenario on the
+# same base.
+ADV_WINDOWS = 4
+FLEET_ADV_KW = dict(FLEET_LANE_KW, adversary=True, adv_windows=ADV_WINDOWS)
+# One dict, two engine names (so call sites read naturally): the engines
+# MUST share the shape — diverging copies would silently compile two
+# adversary families and defeat the single-sourcing this file exists for.
+FLEET_ADV_SER_KW = FLEET_ADV_KW
+FLEET_ADV_LANE_KW = FLEET_ADV_KW
+FLEET_ADV_SERVE_KW = dict(FLEET_ADV_KW, scenario=True, watchdog=True,
+                          watchdog_stall_events=FLEET_WD_STALL)

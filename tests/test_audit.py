@@ -304,12 +304,18 @@ class TestBudgetsAndKnobs:
         assert set(ns) == {"census_off", "census_telemetry",
                            "census_watchdog", "census_sharded",
                            "census_k4", "census_k16", "census_scenario",
+                           "census_adversary", "census_adversary_lane",
                            "tier1_min_dots"}
         assert ns["census_telemetry"] > ns["census_off"]
         # The scenario plane's per-slot selects cost a bounded premium
         # over the off graph (serve/scenario.py; +21 measured round 14).
         assert ns["census_off"] < ns["census_scenario"] \
             <= ns["census_off"] + 100
+        # The adversary plane's windowed decode is the same bounded-
+        # premium story (+9 measured round 17, adversary/plane.py); the
+        # lane window step carries its own (first-recorded) budget.
+        assert ns["census_adversary"] <= ns["census_off"] + 100
+        assert ns["census_adversary_lane"] > 0
         # The macro rungs' dispatched program stays ~flat in K (the
         # rolled inner scan's body is one step): the K=16 budget may not
         # silently balloon past K=4 — fusions-per-event amortization is
