@@ -1,18 +1,31 @@
 """Round-switch plot, capability analog of
 /root/reference/bft-lib/src/visualization/round_switch/round_plotter.py.
 
-Reads the ``round_switches.txt`` CSV written by
-:class:`~librabft_simulator_tpu.analysis.data_writer.DataWriter` and renders
-each node's round number over global time.  matplotlib is optional: without it
-(or with ``--ascii``) an ASCII step plot is printed instead, so the tool works
-in headless/TPU pods.
+Two input formats:
+
+* the ``round_switches.txt`` CSV written by
+  :class:`~librabft_simulator_tpu.analysis.data_writer.DataWriter`
+  (the classic path, unchanged);
+* a saved run-report JSON (``telemetry/report.py run_report`` ->
+  ``save_report``): the flight-recorder tail becomes the round-switch
+  step series (per-actor ``(time, round)`` switch points), and with
+  ``--commit-latency`` the report's geometric commit-latency histogram
+  is rendered against its bucket edges instead.
+
+matplotlib is optional: without it (or with ``--ascii``) an ASCII plot
+is printed instead, so the tool works in headless/TPU pods.  JSON mode
+is jax-free (the version check rides telemetry/schema.py, not the
+jax-importing report module).
 """
 
 from __future__ import annotations
 
 import argparse
 import csv
+import json
 import sys
+
+from ..telemetry import schema as tschema
 
 
 def read_csv(csv_path):
@@ -34,6 +47,64 @@ def step_series(csv_data):
     return series
 
 
+# ---------------------------------------------------------------------------
+# Run-report JSON mode.
+# ---------------------------------------------------------------------------
+
+
+def load_report_json(path):
+    """A saved run-report, version-checked without importing jax."""
+    with open(path) as f:
+        report = json.load(f)
+    tschema.require_registry_version(report.get("registry_version"),
+                                     what=f"run-report {path}")
+    return report
+
+
+def flight_round_series(report):
+    """Per-actor (time, round) switch points from the decoded flight tail.
+
+    The flight recorder logs every handled event with the actor's round
+    AFTER handling, so consecutive rows with a changed round ARE the
+    round switches — no separate switch log needed.  Only per-instance
+    reports carry ``flight``; a fleet-aggregate report raises with the
+    fix (re-save with ``instance=``).
+    """
+    if "flight" not in report:
+        raise ValueError(
+            "run-report has no 'flight' rows: fleet-aggregate reports "
+            "carry merged metrics only — save the report with instance= "
+            "(run_report(p, st, instance=i)) to plot one instance's "
+            "round switches")
+    by_actor: dict = {}
+    for row in report["flight"]:
+        by_actor.setdefault(int(row["actor"]), []).append(
+            (int(row["time"]), int(row["round"])))
+    n = max(by_actor) + 1 if by_actor else 0
+    series = []
+    for actor in range(n):
+        pts, last = [], None
+        for t, rnd in sorted(by_actor.get(actor, [])):
+            if rnd != last:
+                pts.append((t, rnd))
+                last = rnd
+        series.append(pts)
+    return series
+
+
+def commit_latency_hist(report):
+    """(edges, counts) of the report's commit-latency histogram."""
+    metrics = report.get("metrics") or {}
+    if "commit_lat_hist" not in metrics or "histogram_edges" not in report:
+        raise ValueError(
+            "run-report has no commit-latency histogram: the report was "
+            "saved with telemetry off (SimParams.telemetry=True records "
+            "commit_lat_hist + histogram_edges)")
+    counts = [int(c) for c in metrics["commit_lat_hist"]]
+    edges = [int(e) for e in report["histogram_edges"]]
+    return edges, counts
+
+
 def plot_matplotlib(series, out=None):
     import matplotlib
 
@@ -51,6 +122,27 @@ def plot_matplotlib(series, out=None):
     plt.xlabel("Time")
     plt.ylabel("Round number")
     plt.grid(axis="both", which="both")
+    if out:
+        plt.savefig(out, dpi=120)
+        print(f"wrote {out}")
+    else:
+        plt.show()
+
+
+def plot_hist_matplotlib(edges, counts, out=None):
+    import matplotlib
+
+    matplotlib.use("Agg" if out else matplotlib.get_backend())
+    import matplotlib.pyplot as plt
+
+    labels = [f"<{e}" for e in edges[1:]] + [f">={edges[-1]}"]
+    labels = labels[:len(counts)]
+    plt.figure()
+    plt.bar(range(len(counts)), counts)
+    plt.xticks(range(len(counts)), labels, rotation=45, fontsize=7)
+    plt.xlabel("Commit latency (sim time, geometric buckets)")
+    plt.ylabel("Commits")
+    plt.grid(axis="y")
     if out:
         plt.savefig(out, dpi=120)
         print(f"wrote {out}")
@@ -78,13 +170,51 @@ def plot_ascii(series, width=72, height=18, file=None):
         print("".join(row), file=file)
 
 
+def plot_ascii_hist(edges, counts, width=48, file=None):
+    file = file or sys.stdout
+    total = sum(counts)
+    if not total:
+        print("(no commits recorded)", file=file)
+        return
+    peak = max(counts)
+    print(f"commit latency histogram ({total} commits; geometric buckets)",
+          file=file)
+    for i, c in enumerate(counts):
+        lo = edges[i] if i < len(edges) else edges[-1]
+        label = f"<{edges[i + 1]}" if i + 1 < len(edges) else f">={lo}"
+        bar = "#" * int(c / peak * width)
+        print(f"{label:>8s} |{bar:<{width}s}| {c}", file=file)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("csv_path", help="round_switches.txt from DataWriter")
+    ap.add_argument("path", help="round_switches.txt from DataWriter, or a "
+                                 "saved run-report .json")
     ap.add_argument("--out", help="save PNG instead of showing")
     ap.add_argument("--ascii", action="store_true", help="force ASCII output")
+    ap.add_argument("--commit-latency", action="store_true",
+                    help="plot the report's commit-latency histogram "
+                         "(JSON reports only)")
     args = ap.parse_args(argv)
-    series = step_series(read_csv(args.csv_path))
+
+    if args.path.endswith(".json"):
+        report = load_report_json(args.path)
+        if args.commit_latency:
+            edges, counts = commit_latency_hist(report)
+            if args.ascii:
+                plot_ascii_hist(edges, counts)
+                return
+            try:
+                plot_hist_matplotlib(edges, counts, args.out)
+            except ImportError:
+                plot_ascii_hist(edges, counts)
+            return
+        series = flight_round_series(report)
+    else:
+        if args.commit_latency:
+            ap.error("--commit-latency needs a run-report .json (the CSV "
+                     "records round switches only)")
+        series = step_series(read_csv(args.path))
     if args.ascii:
         plot_ascii(series)
         return

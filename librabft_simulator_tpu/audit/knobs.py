@@ -273,6 +273,36 @@ KNOBS: tuple[Knob, ...] = (
          "Component profile: repetitions."),
     Knob("PHO", "script", "scripts/component_profile.py", "0|1",
          "Component profile: epoch handoff on."),
+    Knob("LIBRABFT_OBS_WINDOW_S", "engine", "telemetry/observatory.py",
+         "float > 0",
+         "Fleet observatory: default rollup window (seconds, default "
+         "1.0) for windowed counter/gauge aggregation over ingested "
+         "NDJSON streams.  Query-time only — ingest stores raw rows."),
+    Knob("BENCH_SENTINEL_REPS", "script", "scripts/perf_sentinel.py",
+         "int >= 1",
+         "Perf sentinel: measurements per rung; the history row records "
+         "the median (default 3), so one scheduler hiccup cannot poison "
+         "a baseline."),
+    Knob("BENCH_SENTINEL_OUT", "script", "scripts/perf_sentinel.py",
+         "path",
+         "Perf sentinel: history NDJSON path (default the committed "
+         "BENCH_HISTORY.ndjson at the repo root)."),
+    Knob("BENCH_SENTINEL_RUNGS", "script", "scripts/perf_sentinel.py",
+         "name,name,...",
+         "Perf sentinel: comma-separated subset of the canonical rung "
+         "matrix (serial_step lane_step fleet_chunk macro_k16 aot_ttfc "
+         "serve_admit; default all)."),
+    Knob("BENCH_SENTINEL_TOL_PCT", "script", "scripts/perf_sentinel.py",
+         "float > 0",
+         "Perf sentinel: regression tolerance in percent over the "
+         "rolling-median baseline (default scripts/budgets.py "
+         "bench_sentinel_tol_pct; ci_tier1.sh materializes it)."),
+    Knob("BENCH_SENTINEL_SLOWDOWN", "script", "scripts/perf_sentinel.py",
+         "float >= 1",
+         "Perf sentinel self-test hook: scale every recorded value this "
+         "factor WORSE after measurement (rates divided, times "
+         "multiplied) — proves the gate fires without burning the CPU "
+         "(tests/test_observatory.py)."),
 )
 
 REGISTERED = frozenset(k.name for k in KNOBS)

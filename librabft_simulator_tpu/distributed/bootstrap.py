@@ -114,9 +114,20 @@ def init_from_env() -> DistContext:
         # mesh and gloo must stay unarmed (local_cluster children and
         # the test suite both pin cpu explicitly).
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    if not _already_initialized():
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nproc, process_id=pid)
+    # The initialize barrier is the cross-host clock anchor: every
+    # process leaves the coordinator handshake at (nearly) the same wall
+    # instant, so recording it as a ledger span — the ledger epoch is
+    # created HERE, by the get() — gives the observatory's trace merge a
+    # per-host offset (align the handshake-span ends) without any
+    # wall-clock exchange.  Already-initialized processes (tests driving
+    # initialize themselves) record a zero-width span: offset 0.
+    from ..telemetry import ledger as tledger
+
+    with tledger.get().span(tledger.HANDSHAKE, process_id=pid,
+                            process_count=nproc, coordinator=coord):
+        if not _already_initialized():
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=nproc, process_id=pid)
     _CTX = DistContext(pid, nproc, coord, True)
     return _CTX
 

@@ -48,6 +48,7 @@ from ..parallel import sharded
 from ..sim import byzantine
 from ..sim import simulator as sim_ops
 from ..telemetry import ledger as tledger
+from ..telemetry import schema as tschema
 from ..telemetry import stream as tstream
 from . import scenario as sc
 
@@ -540,7 +541,7 @@ class ResidentFleet:
         # unlocked deque iteration raises (or the sidecar lands torn).
         with self._qlock:
             side = {
-                "serve_version": 1,
+                "serve_version": tschema.SERVE_VERSION,
                 "slots": self.slots,
                 "chunk": self.chunk,
                 "chunks_polled": self.chunks_polled,
@@ -560,10 +561,8 @@ class ResidentFleet:
 
         with open(path + ".serve.json") as f:
             side = json.load(f)
-        if side.get("serve_version") != 1:
-            raise ValueError(
-                f"{path}.serve.json: serve_version "
-                f"{side.get('serve_version')} != 1 (foreign artifact)")
+        tschema.require_serve_version(side.get("serve_version"),
+                                      what=f"{path}.serve.json")
         svc = cls(p, slots=side["slots"], mesh=mesh, chunk=side["chunk"],
                   engine=engine, out=out, fresh_state=False)
         # Host-restore + device_put placement (NOT checkpoint.load_sharded's

@@ -71,7 +71,10 @@ import time
 
 #: Schema version of the NDJSON rows / Perfetto export; readers refuse a
 #: mismatch (the stream-registry discipline of telemetry/stream.py).
-LEDGER_VERSION = 1
+#: Single-sourced from the schema version table (telemetry/schema.py).
+from . import schema  # noqa: E402
+
+LEDGER_VERSION = schema.LEDGER_VERSION
 
 #: Env knob: stream the process ledger as NDJSON to this path (rows are
 #: flushed as recorded; a summary row lands on clean close).
@@ -90,6 +93,13 @@ RUN = "run"
 # is reconstructible from the stream.
 ADMIT = "admit"
 EGRESS = "egress"
+# Distributed bootstrap (distributed/bootstrap.py): the barrier inside
+# jax.distributed.initialize.  All processes leave the coordinator
+# handshake at (nearly) the same wall instant, so the span's END is the
+# per-host clock-offset anchor the observatory's cross-host trace merge
+# aligns ledgers on (each process's ledger epoch starts at its own
+# perf_counter zero — incomparable across hosts without this anchor).
+HANDSHAKE = "handshake"
 
 #: A poll that returns faster than this means the chunk's digest was
 #: already sitting on host when the loop got to it: the device finished
@@ -656,10 +666,7 @@ def load_ndjson(path: str) -> tuple[dict, list[dict]]:
             "artifact (fleet digest streams are read by fleet_watch "
             "without --ledger)")
     meta = metas[0]
-    if meta.get("ledger_version") != LEDGER_VERSION:
-        raise ValueError(
-            f"{path}: ledger_version {meta.get('ledger_version')!r} does "
-            f"not match this build's v{LEDGER_VERSION}")
+    schema.require_ledger_version(meta.get("ledger_version"), what=path)
     return meta, [r for r in rows if r.get("kind") != "meta"]
 
 

@@ -1,0 +1,414 @@
+"""Fleet observatory: the unified cross-stream event store, windowed
+rollups, and the cross-host trace merge.
+
+The repo's observability grew one stream at a time — per-host ``[13]``
+digest NDJSON (stream.py), runtime-ledger span/compile rows (ledger.py),
+serve request-lifecycle rows riding the digest stream (serve/service.py)
+— and every consumer (fleet_watch's four views, report decoders, tests)
+parsed its own kind privately.  This module is the one ingest layer over
+all of them:
+
+* **Unified event store** — :meth:`Observatory.ingest` sniffs any repo
+  NDJSON artifact (fleet digest stream, ``<base>.p<pid>`` per-host
+  streams, serve stream, runtime ledger), version-checks it against the
+  telemetry/schema.py table with the SAME refusal messages the private
+  loaders always raised, and lands every row in one tagged store keyed by
+  host / stream / kind / run / chunk / request.  :func:`load_stream` is
+  the jax-free fleet-stream loader (stream.load_ndjson delegates here),
+  so viewers never pay a backend import.
+
+* **Windowed rollups** — :meth:`Observatory.rollup` folds the digest
+  time series into fixed windows (``LIBRABFT_OBS_WINDOW_S``): monotone
+  counters (schema.COUNTER_SLOTS) become per-window deltas, gauges fold
+  with their registered digest aggregation, and :meth:`histogram` buckets
+  raw samples into the same geometric bins as the in-graph telemetry
+  plane (utils/quantile.py), with bounded p50/p99 readouts.
+
+* **Cross-host trace merge** — each process's ledger epoch is its own
+  ``perf_counter`` zero, incomparable across hosts.  The distributed
+  bootstrap records the ``jax.distributed.initialize`` barrier as a
+  ``handshake`` span (distributed/bootstrap.py): all processes leave the
+  coordinator handshake at (nearly) the same wall instant, so aligning
+  the handshake-span ENDS gives per-host clock offsets
+  (:meth:`clock_offsets`) without any wall-clock exchange, and
+  :meth:`merged_perfetto` exports ONE Chrome-trace/Perfetto JSON with
+  every host's spans on its own process track, correctly interleaved
+  (``scripts/fleet_watch.py --timeline``).
+
+Strictly host-side and jax-free (ledger + schema + numpy + the quantile
+tables): nothing here can touch a trace, so the compiled graphs are
+byte-identical with the observatory armed (pinned by
+tests/test_observatory.py, the ledger-inertness pattern).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import re
+
+import numpy as np
+
+from ..utils import quantile
+from . import ledger as tledger
+from . import schema
+
+#: Env knob: rollup window length in seconds (float; default 1.0).
+WINDOW_ENV = "LIBRABFT_OBS_WINDOW_S"
+DEFAULT_WINDOW_S = 1.0
+
+#: Stream families the sniffer can identify (the tag every stored event
+#: carries as ``_stream``).
+FLEET = "fleet"     # digest stream (TimelineRecorder; kind row/fleet/...)
+SERVE = "serve"     # digest stream with the serve marker + request rows
+LEDGER = "ledger"   # runtime-ledger span/compile/run/summary rows
+
+#: Per-host stream suffix (distributed.egress.host_stream_path writes
+#: <base>.p<pid>.ndjson; local_cluster ledgers are ledger-p<pid>.ndjson).
+_HOST_RE = re.compile(r"[.\-]p(\d+)\.ndjson$")
+
+
+def _window_from_env() -> float:
+    raw = os.environ.get(WINDOW_ENV, "").strip()
+    return float(raw) if raw else DEFAULT_WINDOW_S
+
+
+def load_stream(path: str) -> tuple[dict, list[dict]]:
+    """Read a fleet/serve digest-stream file back: ``(meta, rows)``.
+
+    The canonical (jax-free) implementation of stream.load_ndjson, which
+    delegates here — refusal contract unchanged: a foreign
+    registry_version and a meta-less file both fail loud, a truncated
+    FINAL line is tolerated (ledger.read_ndjson)."""
+    meta, rows = None, []
+    for obj in tledger.read_ndjson(path):
+        if obj.get("kind") == "meta":
+            schema.require_registry_version(
+                obj.get("registry_version"), what=f"stream file {path}")
+            meta = obj
+        else:
+            rows.append(obj)
+    if meta is None:
+        raise ValueError(
+            f"stream file {path} has no meta line; not a fleet-stream "
+            "NDJSON artifact (or written by a pre-stream build, or still "
+            "empty — retry once the run has started)")
+    return meta, rows
+
+
+def sniff(path: str) -> str:
+    """Which stream family a repo NDJSON artifact belongs to (by its meta
+    line): :data:`FLEET`, :data:`SERVE`, or :data:`LEDGER`.  Meta-less /
+    foreign files fail with the fleet-stream refusal (the common case: a
+    still-empty stream)."""
+    for obj in tledger.read_ndjson(path):
+        if obj.get("kind") != "meta":
+            continue
+        if obj.get("schema") == "runtime_ledger":
+            return LEDGER
+        if "registry_version" in obj:
+            return SERVE if obj.get("serve") else FLEET
+        break
+    raise ValueError(
+        f"stream file {path} has no meta line; not a fleet-stream "
+        "NDJSON artifact (or written by a pre-stream build, or still "
+        "empty — retry once the run has started)")
+
+
+def host_label(path: str, meta: dict) -> str:
+    """The host tag for one stream file: the writer's process index when
+    the meta carries one (fleet streams from distributed/workers.py),
+    else the ``.p<pid>`` / ``-p<pid>`` filename convention, else host 0
+    (single-process artifacts)."""
+    pid = meta.get("process_id")
+    if pid is None:
+        m = _HOST_RE.search(path)
+        pid = int(m.group(1)) if m else 0
+    return f"p{int(pid)}"
+
+
+class Observatory:
+    """The tagged cross-stream event store + query API.
+
+    Every ingested row is stored as-written plus three reserved tags:
+    ``_stream`` (fleet/serve/ledger), ``_host`` (``p<k>``), ``_path``
+    (source file).  Querying never mutates; one Observatory can hold a
+    whole cluster run's artifacts (every per-host stream + every per-host
+    ledger) and answer across them."""
+
+    def __init__(self, window_s: float | None = None):
+        self.window_s = window_s if window_s is not None \
+            else _window_from_env()
+        self.sources: list[dict] = []   # {path, stream, host, meta}
+        self.events: list[dict] = []
+
+    # -- ingest ----------------------------------------------------------
+
+    def ingest(self, path: str, host: str | None = None) -> dict:
+        """Sniff + load one NDJSON artifact into the store; returns the
+        source record.  Version refusals are the original loaders' (the
+        schema.py table): foreign artifacts never half-ingest."""
+        kind = sniff(path)
+        if kind == LEDGER:
+            meta, rows = tledger.load_ndjson(path)
+        else:
+            meta, rows = load_stream(path)
+        tag = host if host is not None else host_label(path, meta)
+        src = {"path": path, "stream": kind, "host": tag, "meta": meta}
+        self.sources.append(src)
+        for r in rows:
+            self.events.append(dict(r, _stream=kind, _host=tag,
+                                    _path=path))
+        return src
+
+    def ingest_glob(self, pattern: str) -> list[dict]:
+        """Ingest every file a glob matches (per-host stream / ledger
+        sets); zero matches fails loud — the fleet_watch --merge
+        contract."""
+        paths = sorted(_glob.glob(pattern))
+        if not paths:
+            raise ValueError(
+                f"{pattern!r} matched no files (per-host streams are "
+                "named <base>.p<pid>.ndjson — distributed.egress."
+                "host_stream_path; per-host ledgers ledger-p<pid>.ndjson "
+                "— distributed.local_cluster)")
+        return [self.ingest(p) for p in paths]
+
+    # -- query -----------------------------------------------------------
+
+    def select(self, stream: str | None = None, kind: str | None = None,
+               host: str | None = None, run: int | None = None,
+               chunk: int | None = None, request: str | None = None,
+               since: float | None = None,
+               until: float | None = None) -> list[dict]:
+        """Filtered events (stored order).  ``since``/``until`` bound the
+        row's native timestamp (``t_s`` for stream rows, ``t0_s`` for
+        ledger spans); rows with no timestamp only survive an unbounded
+        query."""
+        out = []
+        for e in self.events:
+            if stream is not None and e["_stream"] != stream:
+                continue
+            if kind is not None and e.get("kind") != kind:
+                continue
+            if host is not None and e["_host"] != host:
+                continue
+            if run is not None and e.get("run") != run:
+                continue
+            if chunk is not None and e.get("chunk") != chunk:
+                continue
+            if request is not None and e.get("id") != request:
+                continue
+            if since is not None or until is not None:
+                t = e.get("t_s", e.get("t0_s"))
+                if t is None:
+                    continue
+                if since is not None and t < since:
+                    continue
+                if until is not None and t >= until:
+                    continue
+            out.append(e)
+        return out
+
+    def hosts(self) -> list[str]:
+        return sorted({s["host"] for s in self.sources})
+
+    def series(self, field: str, kind: str = "row",
+               host: str | None = None) -> list[tuple[float, float]]:
+        """One field's time series: [(t_s, value)] over matching rows
+        that carry both."""
+        return [(e["t_s"], e[field])
+                for e in self.select(kind=kind, host=host)
+                if "t_s" in e and field in e]
+
+    def final_digest(self, host: str | None = None) -> dict | None:
+        """The last digest row's decoded slots (+ watchdog_flags).  The
+        in-graph digest is mesh-reduced, so ANY host's final row reports
+        the whole fleet; per-host reads are the cross-check."""
+        rows = self.select(stream=None, kind="row", host=host)
+        rows = [r for r in rows if r["_stream"] in (FLEET, SERVE)]
+        if not rows:
+            return None
+        last = max(rows, key=lambda r: (r.get("t_s", 0.0),
+                                        r.get("chunk", 0)))
+        out = {n: last[n] for n, _ in schema.DIGEST_SLOTS if n in last}
+        if "watchdog_flags" in last:
+            out["watchdog_flags"] = last["watchdog_flags"]
+        return out
+
+    def requests(self) -> dict[str, list[dict]]:
+        """Serve request-lifecycle rows grouped by request id, each
+        group in stored (chronological) order."""
+        out: dict[str, list[dict]] = {}
+        for e in self.select(kind="request"):
+            out.setdefault(str(e.get("id")), []).append(e)
+        return out
+
+    # -- rollups ---------------------------------------------------------
+
+    def rollup(self, window_s: float | None = None,
+               host: str | None = None) -> list[dict]:
+        """The digest time series folded into fixed windows.
+
+        Monotone cumulative counters (schema.COUNTER_SLOTS) report the
+        per-window DELTA (events this window, not since boot); gauges
+        fold with their registered digest aggregation (queue pressure
+        max, committed-round min/max span); ``halted`` reports its last
+        value (fleet halt progress).  Each window row carries
+        ``t0_s``/``t1_s``/``rows`` plus an ``ev_per_s`` rate.  One host's
+        view when ``host`` is given; otherwise host p0's stream if
+        present (every host's digest is mesh-reduced — summing across
+        hosts would double-count the fleet)."""
+        w = window_s if window_s is not None else self.window_s
+        if host is None:
+            hosts = self.hosts()
+            host = "p0" if "p0" in hosts else (hosts[0] if hosts else None)
+        rows = sorted((r for r in self.select(kind="row", host=host)
+                       if "t_s" in r), key=lambda r: r["t_s"])
+        if not rows:
+            return []
+        counters = [n for n, _ in schema.DIGEST_SLOTS
+                    if n in schema.COUNTER_SLOTS]
+        gauges = [(n, agg) for n, agg in schema.DIGEST_SLOTS
+                  if n not in schema.COUNTER_SLOTS]
+        out = []
+        prev = {n: 0 for n in counters}  # cumulative value before window
+        k = 0
+        i = 0
+        while i < len(rows):
+            t0, t1 = k * w, (k + 1) * w
+            k += 1
+            wrows = []
+            while i < len(rows) and rows[i]["t_s"] < t1:
+                wrows.append(rows[i])
+                i += 1
+            if not wrows:
+                continue  # empty windows are omitted, not zero-filled
+            last = wrows[-1]
+            win = {"t0_s": t0, "t1_s": t1, "rows": len(wrows),
+                   "host": host}
+            for n in counters:
+                cur = int(last.get(n, prev[n]))
+                win[n] = cur - prev[n]
+                prev[n] = cur
+            for n, agg in gauges:
+                vals = [int(r[n]) for r in wrows if n in r]
+                if not vals:
+                    continue
+                if n == "halted":
+                    win[n] = vals[-1]
+                elif agg == schema.MAX:
+                    win[n] = max(vals)
+                elif agg == schema.MIN:
+                    win[n] = min(vals)
+                else:
+                    win[n] = vals[-1]
+            span = max(last["t_s"] - t0, 1e-9) if not out \
+                else max(last["t_s"] - out[-1]["_t_last"], 1e-9)
+            win["ev_per_s"] = round(win.get("events", 0) / span, 1)
+            win["_t_last"] = last["t_s"]
+            out.append(win)
+        for winrow in out:
+            winrow.pop("_t_last", None)
+        return out
+
+    @staticmethod
+    def histogram(values) -> dict:
+        """Raw samples -> the telemetry plane's geometric buckets
+        (utils/quantile.py) with bounded p50/p99 — the host-side twin of
+        the in-graph latency histograms, for sample sets that never went
+        through the plane (serve admission latencies, sentinel reps)."""
+        vals = np.asarray(list(values), dtype=np.float64)
+        counts = np.zeros(quantile.HIST_BUCKETS, dtype=np.int64)
+        if vals.size:
+            b = quantile.bucket_np(np.maximum(vals, 0).astype(np.int64))
+            np.add.at(counts, b, 1)
+        return {"counts": [int(c) for c in counts],
+                "p50_bounds": list(quantile.histogram_quantile(counts, .5)),
+                "p99_bounds": list(quantile.histogram_quantile(counts, .99))}
+
+    # -- cross-host trace merge ------------------------------------------
+
+    def clock_offsets(self) -> dict[str, float]:
+        """Per-host seconds to ADD to a host's ledger timestamps to land
+        them on the reference host's clock (the lowest-numbered host with
+        a handshake span; offset 0.0 for it and for hosts that never
+        recorded one — single-process ledgers are their own reference).
+
+        Anchor: the ``handshake`` span around jax.distributed.initialize
+        (distributed/bootstrap.py) ENDS when the coordinator releases all
+        processes — the same wall instant everywhere up to barrier skew,
+        which is orders below the chunk timescale this merge serves."""
+        ends: dict[str, float] = {}
+        for e in self.select(stream=LEDGER, kind="span"):
+            if e.get("name") != tledger.HANDSHAKE:
+                continue
+            end = float(e["t0_s"]) + float(e["dur_s"])
+            # Keep the FIRST handshake per host (re-inits re-anchor
+            # nothing: initialize is once-only per process).
+            ends.setdefault(e["_host"], end)
+        offsets = {h: 0.0 for h in self.hosts()}
+        if not ends:
+            return offsets
+        ref = sorted(ends)[0]
+        for h, end in ends.items():
+            offsets[h] = ends[ref] - end
+        return offsets
+
+    def merged_perfetto(self, path: str | None = None) -> dict:
+        """ONE Chrome-trace/Perfetto JSON over every ingested ledger:
+        each host is a process track (pid = host index, labeled via 'M'
+        process_name metadata), span timestamps shifted by
+        :meth:`clock_offsets` so cross-host ordering is real.  Load in
+        ui.perfetto.dev; host dispatch/poll spans from all processes
+        interleave on one timeline (tunnel-checklist item 10's host
+        half)."""
+        offsets = self.clock_offsets()
+        events = []
+        seen_hosts = []
+        for e in self.select(stream=LEDGER, kind="span"):
+            h = e["_host"]
+            pid = int(h[1:]) if h[1:].isdigit() else 0
+            if h not in seen_hosts:
+                seen_hosts.append(h)
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": pid,
+                               "args": {"name": f"host {h}"}})
+            attrs = {k: v for k, v in e.items()
+                     if k not in ("kind", "name", "t0_s", "dur_s",
+                                  "thread", "parent", "depth", "_stream",
+                                  "_host", "_path")}
+            events.append({
+                "name": e["name"],
+                "cat": "librabft_host",
+                "ph": "X",
+                "ts": round((float(e["t0_s"]) + offsets.get(h, 0.0)) * 1e6,
+                            3),
+                "dur": round(float(e["dur_s"]) * 1e6, 3),
+                "pid": pid,
+                "tid": e.get("thread", 0),
+                "args": dict(attrs, host=h),
+            })
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"schema": "runtime_ledger",
+                          "ledger_version": schema.LEDGER_VERSION,
+                          "hosts": sorted(offsets),
+                          "clock_offsets_s": {h: round(o, 6)
+                                              for h, o in offsets.items()}},
+        }
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
+
+
+def from_paths(paths, window_s: float | None = None) -> Observatory:
+    """Build a store over a list of artifact paths (the one-shot viewer
+    entry: fleet_watch hands every matched file here)."""
+    obs = Observatory(window_s=window_s)
+    for p in paths:
+        obs.ingest(p)
+    return obs

@@ -34,24 +34,12 @@ from . import plane
 
 def require_registry_version(version, what: str = "artifact") -> None:
     """Refuse to decode an artifact written under a different slot-map
-    registry version (telemetry/stream.REGISTRY_VERSION).
+    registry version.  Canonical implementation (and the version table
+    itself) in telemetry/schema.py; this delegate keeps the historical
+    import path every decoder uses."""
+    from . import schema
 
-    The plane/digest/watchdog slot maps are frozen per version — decoding a
-    v-N artifact with v-M code would silently misattribute slots (a
-    reordered counter reads as a different counter, not as an error), so
-    every serialized consumer (stream NDJSON, saved run-reports) carries
-    the version and hard-fails on mismatch.  ``None`` (a pre-versioning
-    artifact) is a mismatch too."""
-    from . import stream
-
-    if version != stream.REGISTRY_VERSION:
-        raise ValueError(
-            f"{what}: slot-registry version {version!r} does not match this "
-            f"build's v{stream.REGISTRY_VERSION}; the telemetry plane / "
-            "digest / watchdog slot maps are frozen per version and decoding "
-            "across versions silently corrupts reports — regenerate the "
-            "artifact with this build (or decode with the build that wrote "
-            "it)")
+    schema.require_registry_version(version, what)
 
 
 def _metrics_np(st, instance: Optional[int] = None) -> np.ndarray:
@@ -197,20 +185,10 @@ def decode_flight(p, st, instance: Optional[int] = None) -> list[dict]:
     ]
 
 
-def histogram_quantile(counts, q: float) -> tuple[int, int]:
-    """(lo, hi) bucket bounds containing the q-th sample of a histogram
-    (inverted-CDF rank: the ceil(q * total)-th sample).  (-1, -1) if empty;
-    ``hi`` of the open-ended last bucket is INT32_MAX."""
-    counts = np.asarray(counts, np.int64)
-    total = int(counts.sum())
-    if total == 0:
-        return (-1, -1)
-    rank = max(int(np.ceil(q * total)), 1)
-    b = int(np.searchsorted(np.cumsum(counts), rank))
-    edges = quantile.histogram_edges(len(counts))
-    lo = int(edges[b - 1]) if b > 0 else 0
-    hi = int(edges[b]) if b < len(edges) else 2**31 - 1
-    return (lo, hi)
+#: (lo, hi) bucket bounds containing the q-th histogram sample — the math
+#: now lives jax-free in utils/quantile.py (the observatory rollups share
+#: it); this name stays for the report-side callers.
+histogram_quantile = quantile.histogram_quantile
 
 
 def _quantile_block(counts) -> dict:

@@ -1,0 +1,118 @@
+"""The one NDJSON/artifact schema-version table — every serialized
+observability surface, single-sourced.
+
+Three stream families accreted their own private version stamps across
+PRs 4/7/9: the fleet digest stream froze its slot maps behind
+``stream.REGISTRY_VERSION``, the runtime ledger stamped
+``ledger_version`` on its meta line, and the resident service's
+save/restore sidecar carried ``serve_version`` — three constants, three
+refusal paths, three places a version bump could be forgotten.  This
+module is the hoist: one table of every stream kind's frozen version,
+consumed by the writers (stream.py, ledger.py, serve/service.py — their
+public constants are re-exports of this table), by each loader's refusal
+path, and by the observatory ingest (:mod:`.observatory`), which
+dispatches on the meta line's kind and refuses a foreign version with
+the SAME messages the private loaders always used.
+
+Strictly jax-free and numpy-free: the viewers (scripts/fleet_watch.py,
+scripts/bench_index.py) and the ledger CLI import this from processes
+that never touch a backend.
+"""
+
+from __future__ import annotations
+
+#: Frozen schema version per serialized stream kind.  Bump an entry when
+#: ANY field/slot of that kind is added, removed, or reordered; every
+#: decoder hard-refuses a mismatch.
+VERSIONS = {
+    # The fleet digest stream (telemetry/stream.py): the telemetry-plane
+    # registration order + the digest/watchdog slot orders below.
+    "fleet_stream": 1,
+    # The runtime-ledger span/compile stream (telemetry/ledger.py) and
+    # its Perfetto export.
+    "runtime_ledger": 1,
+    # The resident service's save/restore sidecar (serve/service.py).
+    "serve_state": 1,
+    # The perf-regression sentinel's committed bench history rows
+    # (scripts/perf_sentinel.py -> BENCH_HISTORY.ndjson).
+    "bench_history": 1,
+}
+
+#: The writers' historical constant names, re-exported for call sites.
+REGISTRY_VERSION = VERSIONS["fleet_stream"]
+LEDGER_VERSION = VERSIONS["runtime_ledger"]
+SERVE_VERSION = VERSIONS["serve_state"]
+BENCH_HISTORY_VERSION = VERSIONS["bench_history"]
+
+# ---------------------------------------------------------------------------
+# Digest slot registry (hoisted from stream.py, which re-exports): the
+# jax-free consumers (observatory rollups, fleet_watch, bench_index) need
+# the slot names AND their fold kinds without importing the traced side.
+# ---------------------------------------------------------------------------
+
+SUM, MAX, MIN = "sum", "max", "min"
+
+DIGEST_SLOTS = (
+    ("halted", SUM),                # instances halted (slot 0 IS the poll)
+    ("events", SUM),                # total events processed
+    ("commits", SUM),               # total per-node commit_count
+    ("drops", SUM),                 # network drops
+    ("overflow", SUM),              # queue/inbox overflow
+    ("queue_depth_max", MAX),       # live (current) per-instance occupancy
+    ("committed_round_min", MIN),   # min over all nodes' hcr
+    ("committed_round_max", MAX),   # max over all nodes' hcr
+    ("wd_stall", SUM),              # watchdog trip counts (0 when off)
+    ("wd_queue_sat", SUM),
+    ("wd_sync_jump", SUM),
+    ("wd_safety_conflict", SUM),
+    ("wd_round_regress", SUM),
+)
+DIGEST_WIDTH = len(DIGEST_SLOTS)
+
+#: Watchdog detectors surfaced in the digest, in wd-plane counter order.
+WD_DETECTORS = ("stall", "queue_sat", "sync_jump", "safety_conflict",
+                "round_regress")
+
+#: Digest slots that are MONOTONE CUMULATIVE totals (windowed rollups
+#: difference them); the rest are point-in-time gauges (rollups fold them
+#: with their DIGEST_SLOTS aggregation kind instead).
+COUNTER_SLOTS = frozenset(
+    name for name, agg in DIGEST_SLOTS if agg == SUM) - {"halted"}
+
+
+def require_registry_version(version, what: str = "artifact") -> None:
+    """Refuse to decode an artifact written under a different slot-map
+    registry version (the canonical implementation;
+    telemetry/report.require_registry_version delegates here).
+
+    The plane/digest/watchdog slot maps are frozen per version — decoding
+    a v-N artifact with v-M code would silently misattribute slots (a
+    reordered counter reads as a different counter, not as an error), so
+    every serialized consumer carries the version and hard-fails on
+    mismatch.  ``None`` (a pre-versioning artifact) is a mismatch too."""
+    if version != REGISTRY_VERSION:
+        raise ValueError(
+            f"{what}: slot-registry version {version!r} does not match this "
+            f"build's v{REGISTRY_VERSION}; the telemetry plane / "
+            "digest / watchdog slot maps are frozen per version and decoding "
+            "across versions silently corrupts reports — regenerate the "
+            "artifact with this build (or decode with the build that wrote "
+            "it)")
+
+
+def require_ledger_version(version, what: str = "ledger file") -> None:
+    """The runtime-ledger twin of :func:`require_registry_version` —
+    the exact refusal ledger.load_ndjson has always raised."""
+    if version != LEDGER_VERSION:
+        raise ValueError(
+            f"{what}: ledger_version {version!r} does "
+            f"not match this build's v{LEDGER_VERSION}")
+
+
+def require_serve_version(version, what: str = "serve sidecar") -> None:
+    """The resident-service sidecar twin (serve/service.restore's
+    refusal, hoisted verbatim)."""
+    if version != SERVE_VERSION:
+        raise ValueError(
+            f"{what}: serve_version "
+            f"{version} != {SERVE_VERSION} (foreign artifact)")

@@ -56,41 +56,17 @@ import numpy as np
 
 I32 = jnp.int32
 
-#: Version of the frozen slot maps (telemetry plane registration order in
-#: plane.py + the digest/watchdog orders below).  Bump when ANY slot is
-#: added, removed, or reordered; decoders hard-refuse mismatches.
-REGISTRY_VERSION = 1
+# The frozen slot maps now live in telemetry/schema.py (the single
+# version table every serialized surface shares); the historical public
+# names are re-exported here so traced code and the test pins keep one
+# import path.  The digest slot registry maps name -> (index, mesh
+# aggregation); D is fixed regardless of SimParams (watchdog slots read 0
+# when the watchdog is off), so every consumer — the poll loop, NDJSON
+# rows, the oracle mirror — sees one stable schema.
+from .schema import (DIGEST_SLOTS, DIGEST_WIDTH, MAX, MIN,  # noqa: F401
+                     REGISTRY_VERSION, SUM, WD_DETECTORS)
 
-# ---------------------------------------------------------------------------
-# Digest slot registry: name -> (index, mesh aggregation).  Fixed D
-# regardless of SimParams (watchdog slots read 0 when the watchdog is off),
-# so every consumer — the poll loop, NDJSON rows, the oracle mirror — sees
-# one stable schema.
-# ---------------------------------------------------------------------------
-
-SUM, MAX, MIN = "sum", "max", "min"
-
-DIGEST_SLOTS = (
-    ("halted", SUM),                # instances halted (slot 0 IS the poll)
-    ("events", SUM),                # total events processed
-    ("commits", SUM),               # total per-node commit_count
-    ("drops", SUM),                 # network drops
-    ("overflow", SUM),              # queue/inbox overflow
-    ("queue_depth_max", MAX),       # live (current) per-instance occupancy
-    ("committed_round_min", MIN),   # min over all nodes' hcr
-    ("committed_round_max", MAX),   # max over all nodes' hcr
-    ("wd_stall", SUM),              # watchdog trip counts (0 when off)
-    ("wd_queue_sat", SUM),
-    ("wd_sync_jump", SUM),
-    ("wd_safety_conflict", SUM),
-    ("wd_round_regress", SUM),
-)
-DIGEST_WIDTH = len(DIGEST_SLOTS)
 SLOT = {name: i for i, (name, _) in enumerate(DIGEST_SLOTS)}
-
-#: Watchdog detectors surfaced in the digest, in wd-plane counter order.
-WD_DETECTORS = ("stall", "queue_sat", "sync_jump", "safety_conflict",
-                "round_regress")
 
 # ---------------------------------------------------------------------------
 # Watchdog plane: per-instance [WD] int32 (zero-width when
@@ -356,20 +332,9 @@ def load_ndjson(path: str) -> tuple[dict, list[dict]]:
 
     Tolerates a truncated FINAL line (the mid-write tail of a run still
     streaming, or of a timeout-killed writer — ledger.read_ndjson); a
-    corrupt line anywhere else still raises."""
-    from . import ledger, report
+    corrupt line anywhere else still raises.  Canonical implementation in
+    the jax-free observatory ingest (telemetry/observatory.load_stream);
+    this delegate keeps the historical import path."""
+    from . import observatory
 
-    meta, rows = None, []
-    for obj in ledger.read_ndjson(path):
-        if obj.get("kind") == "meta":
-            report.require_registry_version(
-                obj.get("registry_version"), what=f"stream file {path}")
-            meta = obj
-        else:
-            rows.append(obj)
-    if meta is None:
-        raise ValueError(
-            f"stream file {path} has no meta line; not a fleet-stream "
-            "NDJSON artifact (or written by a pre-stream build, or still "
-            "empty — retry once the run has started)")
-    return meta, rows
+    return observatory.load_stream(path)

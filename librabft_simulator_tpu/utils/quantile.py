@@ -92,6 +92,27 @@ def bucket_np(x, n_buckets: int = HIST_BUCKETS) -> np.ndarray:
     return np.searchsorted(edges, np.asarray(x), side="right").astype(np.int64)
 
 
+def histogram_quantile(counts, q: float) -> tuple[int, int]:
+    """(lo, hi) bucket bounds containing the q-th sample of a histogram
+    (inverted-CDF rank: the ceil(q * total)-th sample).  (-1, -1) if empty;
+    ``hi`` of the open-ended last bucket is INT32_MAX.
+
+    Canonical home of the decode-side quantile math (report.py delegates):
+    the geometric buckets bound the true quantile rather than estimate it,
+    and the observatory's jax-free rollups need the same fold without
+    importing the telemetry package."""
+    counts = np.asarray(counts, np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return (-1, -1)
+    rank = max(int(np.ceil(q * total)), 1)
+    b = int(np.searchsorted(np.cumsum(counts), rank))
+    edges = histogram_edges(len(counts))
+    lo = int(edges[b - 1]) if b > 0 else 0
+    hi = int(edges[b]) if b < len(edges) else 2**31 - 1
+    return (lo, hi)
+
+
 def make_table(kind: str, **kw) -> np.ndarray:
     if kind == "lognormal":
         return lognormal_table(kw.get("mean", 10.0), kw.get("variance", 4.0))

@@ -1,0 +1,172 @@
+"""The committed-artifact inventory: every bench/audit JSON at the repo
+root, one line each — round, kind, headline metric.
+
+Eighteen rounds of PRs left ~45 committed artifacts (BENCH_*,
+KERNEL_CENSUS_*, GRAPH_AUDIT_*, FUZZ_PARITY_*, ...) whose provenance
+lives scattered across PERF_NOTES.md prose.  This CLI is the
+machine-readable index: it knows each family's headline field and FAILS
+LOUD when a recognized artifact is missing it — a truncated or
+hand-mangled artifact surfaces here instead of silently rotting.
+
+jax-free by design (safe from any process, no device init):
+    python scripts/bench_index.py            # table, sorted by round
+    python scripts/bench_index.py --json     # machine-readable
+    python scripts/bench_index.py --kind GRAPH_AUDIT
+
+The perf sentinel's BENCH_HISTORY.ndjson rides along as one line
+(row count + the latest row's verdicts) — it is NDJSON, not *.json, so
+plain JSON globs skip it; this index does not.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"_r(\d+)")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fmt_rate(v) -> str:
+    return f"{float(v):,.0f} events/s"
+
+
+#: Artifact family -> (filename prefix, headline extractor).  Extractors
+#: raise KeyError/TypeError on a missing field — surfaced as the loud
+#: per-file error this index exists for.
+FAMILIES = (
+    ("BENCH_SCALE", lambda d: _fmt_rate(d["events_per_sec"])),
+    ("BENCH_SWEEP", lambda d: f"{len(d['configs'])} configs"),
+    ("BENCH_TPU_LADDER", lambda d: f"{len(d['ladder'])} ladder rungs"),
+    ("BENCH_TPU_SNAPSHOT", lambda d: _fmt_rate(d["events_per_sec"])),
+    ("BENCH_MACRO", lambda d: f"{len(d['rungs'])} K-rungs, "
+                              f"{len(d['failures'])} failures"),
+    # Rounds 1-2 ran before bench.py emitted parseable metrics: ``parsed``
+    # is present-but-null there (the tail/rc record the run), a degraded
+    # headline — only an absent key is the loud error.
+    ("BENCH", lambda d: _fmt_rate(d["parsed"]["events_per_sec"])
+     if d["parsed"] is not None else f"no parsed metrics (rc={d['rc']})"),
+    ("FUZZ_PARITY", lambda d: f"{d['trials']} trials, "
+                              f"{len(d['failures'])} failures"),
+    ("KERNEL_CENSUS", lambda d: f"{len(d['modes'])} modes censused"),
+    ("GRAPH_AUDIT", lambda d: f"clean={d['clean']}, "
+                              f"{d['n_errors']} errors"),
+    ("RUNTIME_LEDGER", lambda d: f"ttfc={d['time_to_first_chunk_s']}s"),
+    ("MULTICHIP_FLEET", lambda d: f"{len(d['rungs'])} rungs, "
+                                  f"{len(d['failures'])} failures"),
+    ("MULTIHOST_FLEET", lambda d: f"{len(d['rungs'])} rungs, "
+                                  f"{len(d['failures'])} failures"),
+    ("MULTICHIP", lambda d: f"ok={d['ok']}"),
+    ("FLEET_TIMELINE", lambda d: f"{len(d['rungs'])} rungs, "
+                                 f"registry v{d['registry_version']}"),
+    ("BASELINE", lambda d: f"metric: {d['metric']}"),
+)
+
+
+def classify(name: str):
+    """(kind, round) for one artifact filename; round is None for
+    un-rounded files (BASELINE.json), kind is None when unrecognized."""
+    stem = name[:-len(".json")] if name.endswith(".json") else name
+    m = _ROUND_RE.search(stem)
+    rnd = int(m.group(1)) if m else None
+    for prefix, _ in FAMILIES:
+        if stem == prefix or stem.startswith(prefix + "_"):
+            return prefix, rnd
+    return None, rnd
+
+
+def _extract(kind: str, data: dict) -> str:
+    fn = dict(FAMILIES)[kind]
+    return fn(data)
+
+
+def index_rows(root: str) -> tuple[list, list]:
+    """Scan ``root`` -> (rows, errors).  Each row:
+    ``{"file", "kind", "round", "headline"}``; each error a string."""
+    rows, errors = [], []
+    for path in sorted(glob.glob(os.path.join(root, "*.json"))):
+        name = os.path.basename(path)
+        kind, rnd = classify(name)
+        if kind is None:
+            rows.append({"file": name, "kind": "?", "round": rnd,
+                         "headline": "(unrecognized family)"})
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except ValueError as e:
+            errors.append(f"{name}: unparseable JSON ({e})")
+            continue
+        try:
+            headline = _extract(kind, data)
+        except (KeyError, TypeError, IndexError) as e:
+            errors.append(f"{name}: recognized as {kind} but missing its "
+                          f"headline field ({e!r}) — truncated or "
+                          f"hand-edited artifact?")
+            continue
+        rows.append({"file": name, "kind": kind, "round": rnd,
+                     "headline": headline})
+
+    hist = os.path.join(root, "BENCH_HISTORY.ndjson")
+    if os.path.exists(hist):
+        bench = []
+        try:
+            with open(hist) as f:
+                for ln in f:
+                    if ln.strip():
+                        bench.append(json.loads(ln))
+        except ValueError:
+            errors.append("BENCH_HISTORY.ndjson: unparseable row")
+            bench = []
+        bench = [r for r in bench if r.get("kind") == "bench"]
+        if bench:
+            try:
+                last = bench[-1]
+                worst = ("regress" if "regress" in last["verdicts"].values()
+                         else "ok")
+                rows.append({"file": "BENCH_HISTORY.ndjson",
+                             "kind": "BENCH_HISTORY", "round": None,
+                             "headline": f"{len(bench)} rows, latest "
+                                         f"{len(last['rungs'])} rungs "
+                                         f"-> {worst}"})
+            except (KeyError, TypeError) as e:
+                errors.append(f"BENCH_HISTORY.ndjson: bench row missing "
+                              f"its headline field ({e!r})")
+    return rows, errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="inventory the committed bench/audit artifacts")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--kind", default=None,
+                    help="only artifacts of this family prefix")
+    ap.add_argument("--root", default=repo_root(),
+                    help="directory to scan (default: the repo root)")
+    args = ap.parse_args(argv)
+
+    rows, errors = index_rows(args.root)
+    if args.kind:
+        rows = [r for r in rows if r["kind"] == args.kind]
+    rows.sort(key=lambda r: (r["round"] if r["round"] is not None else -1,
+                             r["file"]))
+    if args.json:
+        print(json.dumps({"artifacts": rows, "errors": errors}, indent=1))
+    else:
+        for r in rows:
+            rnd = f"r{r['round']:02d}" if r["round"] is not None else "  -"
+            print(f"{rnd}  {r['kind']:16s} {r['file']:36s} {r['headline']}")
+        print(f"{len(rows)} artifacts")
+    for e in errors:
+        print(f"bench_index: ERROR {e}", file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,14 @@ toolchains.
   ballooning toward K x census_off means the amortization silently died.
 * ``tier1_min_dots`` 39     — the seed suite's dot count at the 870 s
   timeout; PR baselines since run 49-59 (see CHANGES.md).
+* ``bench_sentinel_tol_pct`` 100 — the perf-regression sentinel's noise
+  tolerance (scripts/perf_sentinel.py): a rung regresses only past
+  (1 + pct/100) x its rolling-median baseline, i.e. 2x at the default.
+  Round-18 provenance: shared-CI CPU rung medians (median-of-3 reps)
+  jitter up to ~40-60% run-over-run on the micro shapes, so a 2x gate
+  catches a real dispatch/compile regression while never tripping on
+  scheduler noise; tighten per-run via BENCH_SENTINEL_TOL_PCT once the
+  runner hardware is quieter.
 
 ``DONATION`` (round 16) pins the donation/aliasing verifier's expected
 per-flavor donated-leaf counts (audit/donation_lint.py rule D1) — exact
@@ -87,6 +95,7 @@ BUDGETS = {
     "census_adversary": 1080,
     "census_adversary_lane": 1200,
     "tier1_min_dots": 39,
+    "bench_sentinel_tol_pct": 100,
 }
 
 #: Expected DONATED input-leaf count per runner flavor — the D1 pin
@@ -130,6 +139,7 @@ SH_VARS = {
     "census_adversary": "ADVERSARY_CENSUS_BUDGET",
     "census_adversary_lane": "ADVERSARY_LANE_CENSUS_BUDGET",
     "tier1_min_dots": "TIER1_MIN_DOTS",
+    "bench_sentinel_tol_pct": "BENCH_SENTINEL_TOL_PCT",
 }
 
 

@@ -81,6 +81,22 @@ if [ "${AOT_PREBUILD:-1}" != "0" ]; then
     python -m librabft_simulator_tpu.utils.aot --list || true
 fi
 
+echo "=== perf-regression sentinel (scripts/perf_sentinel.py: canonical rung matrix -> BENCH_HISTORY.ndjson; tolerance ${BENCH_SENTINEL_TOL_PCT}%) ==="
+# Staged right after the AOT prebuild so the aot_ttfc rung measures the
+# store-backed time-to-first-chunk (the headline the store exists for)
+# and the other rungs load warm executables instead of timing XLA.
+# Self-arming gate: with fewer than 3 prior history rows the sentinel
+# records a baseline row and exits 0 (seeding runs can't fail CI); once
+# history is deep enough a rung worse than its rolling median by more
+# than the budgeted tolerance exits 2 — a hard FAIL below.  A timeout
+# (rc 124) is a measurement failure, not a perf verdict: also fatal,
+# since a sentinel that cannot finish its micro matrix means the matrix
+# itself regressed catastrophically.
+timeout -k 10 1500 env JAX_PLATFORMS=cpu \
+    BENCH_SENTINEL_TOL_PCT="${BENCH_SENTINEL_TOL_PCT}" \
+    python scripts/perf_sentinel.py
+sentinel_rc=$?
+
 echo "=== static audit v2, compiled-HLO leg (scatter class + provenance, digest-only root, alias survival) ==="
 # The one audit family that invokes XLA, staged here so its three
 # fleet-shape chunk compiles ride the persistent cache the prebuild
@@ -176,6 +192,12 @@ timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest \
     -p no:xdist -p no:randomly
 dist_rc=$?
 
+echo "=== fleet observatory referees (tests/test_observatory.py non-slow: cross-stream ingest/rollup pins, clock-offset trace merge, sentinel gate self-test) ==="
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_observatory.py -q -m 'not slow' -p no:cacheprovider \
+    -p no:xdist -p no:randomly
+obs_rc=$?
+
 echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro / ${SCENARIO_CENSUS_BUDGET} scenario / ${ADVERSARY_CENSUS_BUDGET} adversary / ${ADVERSARY_LANE_CENSUS_BUDGET} adversary-lane) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-max "${CENSUS_BUDGET}" \
@@ -221,6 +243,16 @@ if [ "$aot_rc" -ne 0 ]; then
 fi
 if [ "$dist_rc" -ne 0 ]; then
     echo "FAIL: multi-process local-cluster referees rc=$dist_rc" >&2
+    exit 1
+fi
+if [ "$obs_rc" -ne 0 ]; then
+    echo "FAIL: fleet observatory referees rc=$obs_rc" >&2
+    exit 1
+fi
+if [ "$sentinel_rc" -ne 0 ]; then
+    echo "FAIL: perf sentinel rc=$sentinel_rc (2 = rung regression vs" \
+         "BENCH_HISTORY.ndjson baseline; anything else = the micro" \
+         "matrix could not be measured)" >&2
     exit 1
 fi
 if [ "$census_rc" -ne 0 ]; then

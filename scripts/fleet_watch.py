@@ -11,6 +11,8 @@ that stream observable from ANOTHER terminal while the run is still going:
     python scripts/fleet_watch.py /tmp/fleet.ndjson --summary  # final digest
     python scripts/fleet_watch.py /tmp/ledger.ndjson --ledger  # host ledger
     python scripts/fleet_watch.py /tmp/serve.ndjson --serve    # admission view
+    python scripts/fleet_watch.py 'wd/ledger-p*.ndjson' \
+        --timeline --out merged.json   # ONE clock-aligned Perfetto trace
 
 One line per polled chunk: halt progress (padding-corrected when the
 runner emitted a fleet meta line), events/s, commit/drop/overflow counts,
@@ -36,7 +38,17 @@ fraction of the double-buffered dispatch, dispatch-queue bubbles, the
 time-to-first-chunk headline, and the compile ledger (per structural
 key, with persistent-cache hit/miss).
 
-No jax import anywhere: the viewer is pure host-side and starts instantly.
+``--timeline`` treats the path as a glob over per-host RUNTIME-LEDGER
+streams (a ``distributed.local_cluster(..., ledger=True)`` workdir's
+``ledger-p<pid>.ndjson`` set) and exports ONE merged Perfetto/Chrome
+trace: per-host clock offsets are estimated from the coordinator
+handshake spans and every host's dispatch/poll spans land clock-aligned
+on their own process track (telemetry/observatory.py).
+
+One-shot views load through the observatory's unified ingest
+(telemetry/observatory.py) — the schema'd store every stream kind lands
+in — rather than per-kind private parsers.  No jax import anywhere: the
+viewer is pure host-side and starts instantly.
 """
 
 from __future__ import annotations
@@ -50,12 +62,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from librabft_simulator_tpu.telemetry import ledger as tledger  # noqa: E402
-from librabft_simulator_tpu.telemetry import report as treport  # noqa: E402
-from librabft_simulator_tpu.telemetry import stream as tstream  # noqa: E402
+from librabft_simulator_tpu.telemetry import observatory as tobs  # noqa: E402
+from librabft_simulator_tpu.telemetry import schema as tschema  # noqa: E402
 
 
 def _flag_names(flags: int) -> str:
-    names = [d for i, d in enumerate(tstream.WD_DETECTORS)
+    names = [d for i, d in enumerate(tschema.WD_DETECTORS)
              if flags & (1 << i)]
     return ",".join(names) if names else "-"
 
@@ -80,7 +92,7 @@ class _View:
     def feed(self, obj: dict) -> None:
         kind = obj.get("kind")
         if kind == "meta":
-            treport.require_registry_version(obj.get("registry_version"),
+            tschema.require_registry_version(obj.get("registry_version"),
                                              what="stream")
             print(f"# fleet stream: n_nodes={obj.get('n_nodes')} "
                   f"watchdog={'on' if obj.get('watchdog') else 'off'} "
@@ -221,7 +233,7 @@ class _ServeView:
     def feed(self, obj: dict) -> None:
         kind = obj.get("kind")
         if kind == "meta":
-            treport.require_registry_version(obj.get("registry_version"),
+            tschema.require_registry_version(obj.get("registry_version"),
                                              what="serve stream")
             if not obj.get("serve"):
                 raise ValueError(
@@ -281,16 +293,16 @@ class _ServeView:
 def show_serve(path: str, out=None) -> int:
     """The --serve one-shot view (exit 1 on empty/foreign files)."""
     out = out if out is not None else sys.stdout
-    meta, rows = tstream.load_ndjson(path)
+    obs = tobs.from_paths([path])
+    meta = obs.sources[0]["meta"]
     view = _ServeView(out=out)
     view.feed(dict(meta, kind="meta"))
-    events = [r for r in rows if r.get("kind") == "request"]
+    events = obs.select(kind="request")
     if not events:
         print("no request rows yet", file=sys.stderr)
         return 1
-    for r in rows:
-        if r.get("kind") == "request":
-            view.feed(r)
+    for r in events:
+        view.feed(r)
     # Closing occupancy summary from the newest row.
     last = events[-1]
     print(f"# pending={last.get('pending')} active={last.get('active')} "
@@ -327,7 +339,7 @@ class _MergeView:
     def feed(self, obj: dict, host: str) -> None:
         kind = obj.get("kind")
         if kind == "meta":
-            treport.require_registry_version(obj.get("registry_version"),
+            tschema.require_registry_version(obj.get("registry_version"),
                                              what=f"stream (host {host})")
             print(f"# host {host}: n_nodes={obj.get('n_nodes')} "
                   f"process {obj.get('process_id', '?')}/"
@@ -370,35 +382,64 @@ def _host_label(path: str, meta: dict) -> str:
 
 
 def show_merge(pattern: str, summary: bool = False, out=None) -> int:
-    """The --merge one-shot view: every matched per-host stream decoded,
-    rows interleaved by wall time, host tag per row.  --summary prints
-    one final-digest JSON per host instead (the digests are mesh-reduced
-    in-graph, so every host's final row reports the whole fleet — the
-    per-host tags are the cross-check)."""
+    """The --merge one-shot view: every matched per-host stream decoded
+    into one observatory store, rows interleaved by wall time, host tag
+    per row.  --summary prints one final-digest JSON per host instead
+    (the digests are mesh-reduced in-graph, so every host's final row
+    reports the whole fleet — the per-host tags are the cross-check)."""
     out = out if out is not None else sys.stdout
-    streams = []
-    for path in _merge_paths(pattern):
-        meta, rows = tstream.load_ndjson(path)
-        streams.append((path, meta, rows))
+    obs = tobs.from_paths(_merge_paths(pattern))
     if summary:
         doc = {}
-        for path, meta, rows in streams:
-            data = [r for r in rows if r.get("kind") == "row"]
+        for src in obs.sources:
+            host = _host_label(src["path"], src["meta"])
+            data = obs.select(kind="row", host=src["host"])
             last = data[-1] if data else None
-            doc[_host_label(path, meta)] = (
+            doc[host] = (
                 None if last is None else
                 {"chunks": len(data), "elapsed_s": last["t_s"],
-                 "final": {n: last[n] for n, _ in tstream.DIGEST_SLOTS}})
+                 "final": {n: last[n] for n, _ in tschema.DIGEST_SLOTS}})
         print(json.dumps(doc, indent=1), file=out)
         return 0
     view = _MergeView(out=out)
-    tagged = []
-    for path, meta, rows in streams:
-        host = _host_label(path, meta)
-        view.feed(dict(meta, kind="meta"), host)
-        tagged += [(r.get("t_s", 0), host, r) for r in rows]
+    labels = {src["path"]: _host_label(src["path"], src["meta"])
+              for src in obs.sources}
+    for src in obs.sources:
+        view.feed(dict(src["meta"], kind="meta"), labels[src["path"]])
+    tagged = [(e.get("t_s", 0), labels[e["_path"]], e) for e in obs.events]
     for _, host, r in sorted(tagged, key=lambda t: (t[0], t[1])):
         view.feed(r, host)
+    return 0
+
+
+def show_timeline(pattern: str, out_path: str, out=None) -> int:
+    """The --timeline export: ingest every matched per-host LEDGER
+    stream (distributed/local_cluster names them ledger-p<pid>.ndjson),
+    estimate per-host clock offsets from the coordinator handshake
+    spans, and write ONE merged Chrome-trace/Perfetto JSON — every
+    host's dispatch/poll spans on its own process track, clock-aligned
+    (telemetry/observatory.py).  Exits 1 on zero matches or a span-less
+    ingest."""
+    out = out if out is not None else sys.stdout
+    obs = tobs.Observatory()
+    obs.ingest_glob(pattern)
+    ledgers = [s for s in obs.sources if s["stream"] == tobs.LEDGER]
+    if not ledgers:
+        raise ValueError(
+            f"--timeline {pattern!r} matched no runtime-ledger streams "
+            "(point it at LIBRABFT_LEDGER_OUT files, e.g. the "
+            "ledger-p*.ndjson set a distributed.local_cluster(..., "
+            "ledger=True) workdir holds)")
+    doc = obs.merged_perfetto(out_path)
+    spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    if not spans:
+        print("no ledger spans yet", file=sys.stderr)
+        return 1
+    offs = doc["otherData"]["clock_offsets_s"]
+    print(f"# merged timeline: {len(ledgers)} host ledger(s), "
+          f"{spans} spans -> {out_path}", file=out)
+    for h in sorted(offs):
+        print(f"#   host {h}: clock offset {offs[h]:+.6f}s", file=out)
     return 0
 
 
@@ -471,6 +512,14 @@ def main(argv=None) -> int:
                          "(<base>.p<pid>.ndjson, distributed/egress.py): "
                          "follow/summarize them as one fleet view with a "
                          "host tag per row; exits 1 on zero matches")
+    ap.add_argument("--timeline", action="store_true",
+                    help="the path is a GLOB over per-host runtime-ledger "
+                         "streams (ledger-p<pid>.ndjson): export ONE "
+                         "merged clock-aligned Perfetto trace to --out "
+                         "(telemetry/observatory.py cross-host merge)")
+    ap.add_argument("--out", default="fleet_timeline.json",
+                    help="--timeline output path (Chrome-trace JSON, "
+                         "loadable in ui.perfetto.dev)")
     ap.add_argument("--poll", type=float, default=0.5,
                     help="follow-mode poll interval in seconds")
     ap.add_argument("--idle-timeout", type=float, default=None,
@@ -478,6 +527,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
+        if args.timeline:
+            return show_timeline(args.path, args.out)
+
         if args.merge:
             if args.once or args.summary:
                 return show_merge(args.path, summary=args.summary)
@@ -497,15 +549,15 @@ def main(argv=None) -> int:
             return 0
 
         if args.summary:
-            meta, rows = tstream.load_ndjson(args.path)
-            data = [r for r in rows if r.get("kind") == "row"]
+            obs = tobs.from_paths([args.path])
+            data = obs.select(kind="row")
             if not data:
                 print("no rows yet", file=sys.stderr)
                 return 1
             last = data[-1]
             print(json.dumps({
                 "chunks": len(data), "elapsed_s": last["t_s"],
-                "final": {n: last[n] for n, _ in tstream.DIGEST_SLOTS},
+                "final": {n: last[n] for n, _ in tschema.DIGEST_SLOTS},
                 "watchdog_flags": last["watchdog_flags"],
                 "watchdog": _flag_names(last["watchdog_flags"]),
             }, indent=1))
@@ -513,9 +565,9 @@ def main(argv=None) -> int:
 
         view = _View()
         if args.once:
-            meta, rows = tstream.load_ndjson(args.path)
-            view.feed(dict(meta, kind="meta"))
-            for r in rows:
+            obs = tobs.from_paths([args.path])
+            view.feed(dict(obs.sources[0]["meta"], kind="meta"))
+            for r in obs.events:
                 view.feed(r)
             return 0
         follow(args.path, view, poll_s=args.poll,

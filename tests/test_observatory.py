@@ -1,0 +1,572 @@
+"""Fleet-observatory referees (telemetry/observatory.py + schema.py +
+scripts/perf_sentinel.py): the round-18 observability layer.
+
+Four contract families:
+
+(a) **Ingest round-trips** — every NDJSON family the repo writes (fleet
+    digest stream, per-host ``.p<pid>`` streams, serve stream with
+    request rows, runtime ledger) lands in ONE store with correct
+    stream/host tags, the original loaders' byte-identical version
+    refusals, and tolerance for a torn FINAL line only.
+(b) **Rollups** — windowed counter deltas re-fold to exactly the raw
+    digest series (hand-folded oracle), on synthetic rows and on a real
+    seeded 2-process local_cluster run.
+(c) **Cross-host trace merge** — handshake-anchored clock offsets make
+    per-host span orderings monotone on one merged Perfetto timeline
+    (synthetic two-host ledgers with a known skew, and the real
+    cluster's ledgers).
+(d) **Perf sentinel gate** — the regression gate stays quiet while
+    seeding (<3 rows), fires on a seeded 3x slowdown, and is green again
+    on an honest re-run; plus the zero-traced-ops inertness pin for the
+    whole layer.
+
+The cluster test rides the warmed /tmp/librabft_aot_dist store like
+tests/test_distributed.py (first-ever run pays the export compiles).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax
+
+from librabft_simulator_tpu.audit import graph_lint as GL
+from librabft_simulator_tpu.core.types import SimParams
+from librabft_simulator_tpu.distributed import bootstrap
+from librabft_simulator_tpu.sim import parallel_sim as PE
+from librabft_simulator_tpu.sim import simulator as S
+from librabft_simulator_tpu.telemetry import ledger as tledger
+from librabft_simulator_tpu.telemetry import observatory as tobs
+from librabft_simulator_tpu.telemetry import schema as tschema
+from librabft_simulator_tpu.telemetry import stream as tstream
+
+from fleet_shapes import FLEET_B, FLEET_CHUNK, FLEET_SER_KW
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SENTINEL = os.path.join(REPO, "scripts", "perf_sentinel.py")
+FLEET_WATCH = os.path.join(REPO, "scripts", "fleet_watch.py")
+
+#: The cluster children's AOT store (tests/test_distributed.py twin).
+DIST_AOT = {"LIBRABFT_AOT_DIR": "/tmp/librabft_aot_dist",
+            "LIBRABFT_AOT_WRITE": "1"}
+
+
+def _load_sentinel():
+    spec = importlib.util.spec_from_file_location("perf_sentinel", SENTINEL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Synthetic stream writers.
+# ---------------------------------------------------------------------------
+
+
+def _write_fleet_stream(path, rows, meta_extra=None, version=None):
+    meta = {"kind": "meta",
+            "registry_version": tschema.REGISTRY_VERSION
+            if version is None else version,
+            "digest_slots": [n for n, _ in tschema.DIGEST_SLOTS],
+            "n_nodes": 3, "watchdog": False, "total_instances": FLEET_B}
+    meta.update(meta_extra or {})
+    with open(path, "w") as f:
+        f.write(json.dumps(meta) + "\n")
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def _digest_row(chunk, t_s, events, halted=0, commits=0, qmax=1,
+                crmin=0, crmax=0):
+    return {"kind": "row", "chunk": chunk, "t_s": t_s, "events": events,
+            "halted": halted, "commits": commits, "drops": 0,
+            "overflow": 0, "queue_depth_max": qmax,
+            "committed_round_min": crmin, "committed_round_max": crmax,
+            "wd_stall": 0, "wd_queue_sat": 0, "wd_sync_jump": 0,
+            "wd_safety_conflict": 0, "wd_round_regress": 0}
+
+
+def _write_ledger(path, pid, handshake_end, spans):
+    """A synthetic per-host runtime ledger: meta + handshake + spans.
+    ``spans`` = [(name, t0, dur, attrs)] on the host's LOCAL clock."""
+    rows = [{"kind": "meta", "schema": "runtime_ledger",
+             "ledger_version": tschema.LEDGER_VERSION},
+            {"kind": "span", "name": tledger.HANDSHAKE,
+             "t0_s": handshake_end - 0.1, "dur_s": 0.1, "thread": 0,
+             "process_id": pid, "process_count": 2}]
+    for name, t0, dur, attrs in spans:
+        rows.append(dict({"kind": "span", "name": name, "t0_s": t0,
+                          "dur_s": dur, "thread": 0}, **attrs))
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# (a) ingest round-trips + refusals.
+# ---------------------------------------------------------------------------
+
+
+def test_schema_is_the_single_source():
+    """The writers' public constants ARE the schema table (hoist, not
+    copies), and stream.py's slot registry re-exports it."""
+    assert tstream.REGISTRY_VERSION is tschema.REGISTRY_VERSION
+    assert tledger.LEDGER_VERSION is tschema.LEDGER_VERSION
+    assert tstream.DIGEST_SLOTS is tschema.DIGEST_SLOTS
+    assert tstream.DIGEST_WIDTH == tschema.DIGEST_WIDTH == 13
+    assert tstream.WD_DETECTORS is tschema.WD_DETECTORS
+    # Every serialized family is versioned, including the bench history.
+    assert set(tschema.VERSIONS) == {"fleet_stream", "runtime_ledger",
+                                     "serve_state", "bench_history"}
+
+
+def test_version_refusals_byte_identical():
+    """The hoisted refusal messages are the legacy loaders' exact
+    phrasings — downstream tooling greps for them."""
+    with pytest.raises(ValueError, match="slot-registry version 99 does "
+                                         "not match this build's v1"):
+        tschema.require_registry_version(99, what="x")
+    with pytest.raises(ValueError,
+                       match="ledger_version 7 does not match this "
+                             "build's v1"):
+        tschema.require_ledger_version(7, what="y")
+    with pytest.raises(ValueError,
+                       match=r"serve_version 3 != 1 \(foreign artifact\)"):
+        tschema.require_serve_version(3, what="z")
+
+
+def test_ingest_round_trip_all_kinds(tmp_path):
+    """One store over a fleet stream, a per-host serve stream, and a
+    ledger: rows keep every original field plus the _stream/_host/_path
+    tags; queries filter across sources."""
+    fleet = _write_fleet_stream(
+        str(tmp_path / "fleet.ndjson"),
+        [_digest_row(0, 0.1, 10), _digest_row(1, 0.4, 30)])
+    serve_rows = [_digest_row(0, 0.2, 5),
+                  {"kind": "request", "event": "submitted", "id": "r0",
+                   "t_s": 0.05, "slot": None, "status": "pending",
+                   "ttfc_s": None, "pending": 1, "active": 0,
+                   "egressed": 0},
+                  {"kind": "request", "event": "admitted", "id": "r0",
+                   "t_s": 0.15, "slot": 2, "status": "active",
+                   "ttfc_s": None, "pending": 0, "active": 1,
+                   "egressed": 0}]
+    serve = _write_fleet_stream(str(tmp_path / "serve.p1.ndjson"),
+                                serve_rows, meta_extra={"serve": True})
+    ledger = _write_ledger(str(tmp_path / "ledger-p0.ndjson"), 0, 0.5,
+                           [(tledger.DISPATCH, 0.6, 0.05,
+                             {"run": 1, "chunk": 0})])
+
+    obs = tobs.from_paths([fleet, serve, ledger])
+    assert obs.hosts() == ["p0", "p1"]
+    assert {s["stream"] for s in obs.sources} == \
+        {tobs.FLEET, tobs.SERVE, tobs.LEDGER}
+    # sniff dispatched each file to the right family.
+    assert tobs.sniff(fleet) == tobs.FLEET
+    assert tobs.sniff(serve) == tobs.SERVE
+    assert tobs.sniff(ledger) == tobs.LEDGER
+    # Round-trip: stored rows == written rows (plus tags).
+    frows = obs.select(stream=tobs.FLEET, kind="row")
+    assert [r["events"] for r in frows] == [10, 30]
+    assert all(r["_host"] == "p0" and r["_path"] == fleet for r in frows)
+    # The serve stream's host came from the .p1 filename convention.
+    reqs = obs.requests()
+    assert list(reqs) == ["r0"]
+    assert [e["event"] for e in reqs["r0"]] == ["submitted", "admitted"]
+    assert reqs["r0"][0]["_host"] == "p1"
+    # Ledger spans visible through the same store.
+    spans = obs.select(stream=tobs.LEDGER, kind="span", run=1)
+    assert len(spans) == 1 and spans[0]["name"] == tledger.DISPATCH
+    # Time-bounded select uses each row's native timestamp.
+    assert [r["events"] for r in obs.select(kind="row", since=0.15,
+                                            until=0.45)] == [30, 5]
+    # final_digest picks the LAST digest row across fleet+serve streams.
+    assert obs.final_digest()["events"] == 30
+
+
+def test_ingest_refuses_foreign_and_meta_less(tmp_path):
+    foreign = _write_fleet_stream(str(tmp_path / "foreign.ndjson"),
+                                  [_digest_row(0, 0.1, 1)], version=99)
+    with pytest.raises(ValueError, match="slot-registry version 99"):
+        tobs.Observatory().ingest(foreign)
+    bare = str(tmp_path / "bare.ndjson")
+    with open(bare, "w") as f:
+        f.write(json.dumps({"kind": "row", "events": 1}) + "\n")
+    with pytest.raises(ValueError, match="has no meta line"):
+        tobs.Observatory().ingest(bare)
+    with pytest.raises(ValueError, match="matched no files"):
+        tobs.Observatory().ingest_glob(str(tmp_path / "nope*.ndjson"))
+
+
+def test_torn_final_line_tolerated_corrupt_midfile_refused(tmp_path):
+    """The crash-mid-write contract, through the ONE shared loader: a
+    torn FINAL line is the reader racing the writer (ignored); a corrupt
+    MID-file line is real corruption (loud)."""
+    path = _write_fleet_stream(str(tmp_path / "torn.ndjson"),
+                               [_digest_row(0, 0.1, 10)])
+    with open(path, "a") as f:
+        f.write('{"kind": "row", "chunk": 1, "ev')  # torn final line
+    obs = tobs.Observatory()
+    obs.ingest(path)
+    assert len(obs.select(kind="row")) == 1
+    # stream.load_ndjson delegates to the same loader -> same tolerance.
+    meta, rows = tstream.load_ndjson(path)
+    assert len(rows) == 1
+
+    corrupt = str(tmp_path / "corrupt.ndjson")
+    with open(path) as f:
+        good = f.read()
+    with open(corrupt, "w") as f:
+        f.write(good.splitlines()[0] + "\n")
+        f.write("NOT JSON\n")
+        f.write(json.dumps(_digest_row(1, 0.2, 20)) + "\n")
+    with pytest.raises(ValueError):  # json.JSONDecodeError, not tolerated
+        tobs.Observatory().ingest(corrupt)
+
+
+# ---------------------------------------------------------------------------
+# (b) rollups == hand-folded digests.
+# ---------------------------------------------------------------------------
+
+
+def test_rollup_hand_folded_synthetic(tmp_path):
+    """Window deltas on a synthetic digest series with known cumulative
+    counters: deltas re-fold to the raw series, gauges fold by their
+    registered kind, empty windows are omitted."""
+    rows = [_digest_row(0, 0.2, 10, halted=0, qmax=4, crmin=1, crmax=2),
+            _digest_row(1, 0.7, 25, halted=1, qmax=2, crmin=0, crmax=5),
+            # window [1,2) empty — chunk 2 lands in [2,3)
+            _digest_row(2, 2.3, 60, halted=3, qmax=9, crmin=2, crmax=7)]
+    path = _write_fleet_stream(str(tmp_path / "fleet.ndjson"), rows)
+    obs = tobs.from_paths([path], window_s=1.0)
+    roll = obs.rollup()
+    assert [w["t0_s"] for w in roll] == [0.0, 2.0]  # empty window omitted
+    # Counter deltas: window 0 saw 0->25 cumulative, window 1 25->60.
+    assert [w["events"] for w in roll] == [25, 35]
+    # Hand-fold oracle: deltas re-accumulate to the final cumulative.
+    assert sum(w["events"] for w in roll) == rows[-1]["events"]
+    # Gauges: max over window rows; min over window rows; halted last.
+    assert roll[0]["queue_depth_max"] == 4 and roll[1]["queue_depth_max"] == 9
+    assert roll[0]["committed_round_min"] == 0
+    assert roll[0]["committed_round_max"] == 5
+    assert [w["halted"] for w in roll] == [1, 3]
+    assert all(w["rows"] > 0 for w in roll)
+
+
+def test_rollup_window_env_knob(tmp_path, monkeypatch):
+    path = _write_fleet_stream(
+        str(tmp_path / "fleet.ndjson"),
+        [_digest_row(0, 0.2, 10), _digest_row(1, 0.3, 20)])
+    monkeypatch.setenv(tobs.WINDOW_ENV, "0.25")
+    roll = tobs.from_paths([path]).rollup()
+    assert len(roll) == 2 and roll[1]["t0_s"] == 0.25
+    assert [w["events"] for w in roll] == [10, 10]
+
+
+def test_histogram_matches_quantile_tables():
+    from librabft_simulator_tpu.utils import quantile
+    h = tobs.Observatory.histogram([1, 1, 3, 200])
+    assert sum(h["counts"]) == 4
+    counts = np.zeros(quantile.HIST_BUCKETS, dtype=np.int64)
+    np.add.at(counts, quantile.bucket_np(np.array([1, 1, 3, 200])), 1)
+    assert h["counts"] == [int(c) for c in counts]
+    assert h["p50_bounds"] == list(quantile.histogram_quantile(counts, .5))
+    assert h["p99_bounds"] == list(quantile.histogram_quantile(counts, .99))
+
+
+# ---------------------------------------------------------------------------
+# (c) cross-host trace merge (synthetic skew oracle).
+# ---------------------------------------------------------------------------
+
+
+def test_clock_offsets_and_monotone_merge(tmp_path):
+    """Two hosts whose ledger epochs differ by a KNOWN 0.2 s skew: the
+    handshake anchor recovers it exactly, and the merged timeline puts
+    simultaneous work at the same merged timestamp, per-host order
+    monotone."""
+    # Host p0's clock: handshake ends 0.5; dispatch at 0.6.
+    # Host p1 started 0.2 s later, so the SAME instants read 0.2 less.
+    lp0 = _write_ledger(str(tmp_path / "ledger-p0.ndjson"), 0, 0.5,
+                        [(tledger.DISPATCH, 0.6, 0.05,
+                          {"run": 1, "chunk": 0}),
+                         (tledger.POLL, 0.66, 0.02,
+                          {"run": 1, "chunk": 0})])
+    lp1 = _write_ledger(str(tmp_path / "ledger-p1.ndjson"), 1, 0.3,
+                        [(tledger.DISPATCH, 0.4, 0.05,
+                          {"run": 1, "chunk": 0}),
+                         (tledger.POLL, 0.46, 0.02,
+                          {"run": 1, "chunk": 0})])
+    obs = tobs.from_paths([lp0, lp1])
+    offs = obs.clock_offsets()
+    assert offs["p0"] == 0.0
+    assert abs(offs["p1"] - 0.2) < 1e-9
+
+    doc = obs.merged_perfetto(str(tmp_path / "merged.json"))
+    with open(tmp_path / "merged.json") as f:
+        assert json.load(f)["otherData"]["hosts"] == ["p0", "p1"]
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == {"host p0", "host p1"}
+    # Clock-aligned: both hosts' dispatches land at the same merged ts.
+    disp = {e["pid"]: e["ts"] for e in xs
+            if e["name"] == tledger.DISPATCH}
+    assert disp[0] == disp[1] == pytest.approx(0.6 * 1e6)
+    # Monotone per-host ordering survives the shift.
+    for pid in (0, 1):
+        ts = [e["ts"] for e in xs if e["pid"] == pid]
+        assert ts == sorted(ts)
+    assert doc["otherData"]["clock_offsets_s"]["p1"] == \
+        pytest.approx(0.2)
+
+
+def test_fleet_watch_timeline_cli_jax_free(tmp_path):
+    """scripts/fleet_watch.py --timeline writes the merged Perfetto doc
+    from per-host ledgers WITHOUT importing jax (the pod-monitor
+    contract), and fails loud on a glob with no ledger streams."""
+    _write_ledger(str(tmp_path / "ledger-p0.ndjson"), 0, 0.5,
+                  [(tledger.DISPATCH, 0.6, 0.05, {"run": 1, "chunk": 0})])
+    _write_ledger(str(tmp_path / "ledger-p1.ndjson"), 1, 0.3,
+                  [(tledger.DISPATCH, 0.4, 0.05, {"run": 1, "chunk": 0})])
+    out = str(tmp_path / "timeline.json")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import builtins, runpy, sys\n"
+         "real = builtins.__import__\n"
+         "def guard(name, *a, **k):\n"
+         "    assert not name.startswith('jax'), 'jax imported: ' + name\n"
+         "    return real(name, *a, **k)\n"
+         "builtins.__import__ = guard\n"
+         f"sys.argv = ['fleet_watch.py', {str(tmp_path / 'ledger-p*.ndjson')!r},"
+         f" '--timeline', '--out', {out!r}]\n"
+         f"runpy.run_path({FLEET_WATCH!r}, run_name='__main__')\n"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["otherData"]["clock_offsets_s"]["p1"] == pytest.approx(0.2)
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+    r2 = subprocess.run([sys.executable, FLEET_WATCH,
+                         str(tmp_path / "none-p*.ndjson"), "--timeline"],
+                        capture_output=True, text=True, env=env)
+    assert r2.returncode != 0
+
+
+# ---------------------------------------------------------------------------
+# (c') the real thing: seeded 2-process cluster -> one merged timeline,
+# rollups vs the raw per-host streams.
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_observatory_end_to_end(tmp_path):
+    """ACCEPTANCE: a seeded 2-process local_cluster fleet run yields ONE
+    merged Perfetto trace with a handshake-anchored offset for every
+    host and monotone per-host span ordering; the observatory's rollups
+    over the per-host digest streams re-fold exactly to each stream's
+    raw cumulative series."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs virtual devices (conftest sets 8)")
+    out_dir = str(tmp_path / "out")
+    work = str(tmp_path / "cluster")
+    bootstrap.local_cluster(
+        2, "librabft_simulator_tpu.distributed.workers:fleet_run",
+        {"params_kw": dict(FLEET_SER_KW, max_clock=120),
+         "engine": "serial", "b": FLEET_B, "chunk": FLEET_CHUNK,
+         "out_dir": out_dir},
+        timeout_s=900, workdir=work, ledger=True, env_extra=DIST_AOT)
+
+    obs = tobs.Observatory()
+    obs.ingest_glob(os.path.join(out_dir, "fleet.p*.ndjson"))
+    obs.ingest_glob(os.path.join(work, "ledger-p*.ndjson"))
+    assert obs.hosts() == ["p0", "p1"]
+
+    # Every host recorded the handshake -> a real (finite) offset each,
+    # reference host pinned to 0.
+    offs = obs.clock_offsets()
+    assert set(offs) == {"p0", "p1"} and offs["p0"] == 0.0
+    handshakes = [e for e in obs.select(stream=tobs.LEDGER, kind="span")
+                  if e.get("name") == tledger.HANDSHAKE]
+    assert {e["_host"] for e in handshakes} == {"p0", "p1"}
+    # Aligned handshake ENDS: the merge's anchor property, on real data.
+    ends = {e["_host"]: e["t0_s"] + e["dur_s"] + offs[e["_host"]]
+            for e in handshakes}
+    assert abs(ends["p0"] - ends["p1"]) < 1e-6
+
+    doc = obs.merged_perfetto(str(tmp_path / "merged.json"))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert xs, "cluster ledgers produced no spans"
+    # Monotone per-host ordering on the MERGED clock: chunk i's dispatch
+    # starts before chunk i+1's, per host per run (spans are emitted at
+    # exit so raw file order proves nothing — the timeline must).
+    for host in ("p0", "p1"):
+        pid = int(host[1:])
+        disp = [e for e in xs if e["pid"] == pid
+                and e["name"] == tledger.DISPATCH
+                and "chunk" in e["args"]]
+        assert disp, f"host {host} dispatched no chunks"
+        by_run: dict = {}
+        for e in disp:
+            by_run.setdefault(e["args"].get("run"), []).append(e)
+        for run, evs in by_run.items():
+            evs.sort(key=lambda e: e["args"]["chunk"])
+            ts = [e["ts"] for e in evs]
+            assert ts == sorted(ts), \
+                f"host {host} run {run} dispatch order not monotone"
+        # And every dispatch happens after the cluster handshake anchor.
+        anchor = ends[host] * 1e6
+        assert min(e["ts"] for e in disp) >= anchor - 1e3
+    # Rollups vs the hand-folded raw stream, per host.
+    for host in ("p0", "p1"):
+        raw = sorted((r for r in obs.select(kind="row", host=host)),
+                     key=lambda r: r["t_s"])
+        assert raw, f"host {host} streamed no digest rows"
+        roll = obs.rollup(window_s=0.05, host=host)
+        for name in sorted(tschema.COUNTER_SLOTS):
+            assert sum(w.get(name, 0) for w in roll) == raw[-1][name], name
+        assert roll[-1]["halted"] == raw[-1]["halted"]
+        hand_max = max(r["queue_depth_max"] for r in raw)
+        assert max(w["queue_depth_max"] for w in roll
+                   if "queue_depth_max" in w) == hand_max
+    # The digest is mesh-reduced: both hosts' final digests agree.
+    assert obs.final_digest(host="p0") == obs.final_digest(host="p1")
+
+
+# ---------------------------------------------------------------------------
+# (d) the perf sentinel's gate + the layer's inertness pin.
+# ---------------------------------------------------------------------------
+
+
+FIXED_SAMPLES = {
+    "serial_step": [1000.0, 1010.0, 990.0],
+    "aot_ttfc": [2.0],
+}
+
+
+def _run_sentinel(ps, monkeypatch, out, slowdown=None):
+    monkeypatch.setattr(
+        ps, "_collect_samples",
+        lambda rungs, reps: {n: FIXED_SAMPLES[n] for n in rungs})
+    monkeypatch.setenv(ps.RUNGS_ENV, "serial_step,aot_ttfc")
+    if slowdown is None:
+        monkeypatch.delenv(ps.SLOWDOWN_ENV, raising=False)
+    else:
+        monkeypatch.setenv(ps.SLOWDOWN_ENV, str(slowdown))
+    return ps.main(["--out", out, "--reps", "3"])
+
+
+def test_sentinel_gate_seeds_fires_and_recovers(tmp_path, monkeypatch):
+    """The gate lifecycle against the REAL history/judge/verdict/rc
+    plumbing (measurement stubbed): 3 seeding runs pass as 'baseline',
+    a seeded 3x slowdown exits 2 with perf-regress ledger spans on BOTH
+    rung polarities, and an honest re-run is green again."""
+    ps = _load_sentinel()
+    out = str(tmp_path / "history.ndjson")
+    for _ in range(3):  # seed: below MIN_HISTORY the gate cannot fail
+        assert _run_sentinel(ps, monkeypatch, out) == 0
+    rows = ps.load_history(out)
+    assert len(rows) == 3
+    assert all(r["verdicts"] == {"serial_step": "baseline",
+                                 "aot_ttfc": "baseline"} for r in rows)
+    assert all(r["bench_history_version"] ==
+               tschema.BENCH_HISTORY_VERSION for r in rows)
+    # Median-of-reps landed in the row, not the raw samples.
+    assert rows[0]["rungs"]["serial_step"]["value"] == 1000.0
+
+    # Armed now: an honest 4th run is 'ok'.
+    assert _run_sentinel(ps, monkeypatch, out) == 0
+    assert ps.load_history(out)[-1]["verdicts"]["serial_step"] == "ok"
+
+    # Seeded 3x slowdown: rate rung drops to ~333 (< 1000/2), time rung
+    # rises to 6.0 (> 2.0*2) -> rc 2, both rungs regress, loud spans.
+    lg = tledger.get()
+    before = len(lg.spans)
+    rc = _run_sentinel(ps, monkeypatch, out, slowdown=3)
+    assert rc == 2
+    last = ps.load_history(out)[-1]
+    assert last["verdicts"] == {"serial_step": "regress",
+                                "aot_ttfc": "regress"}
+    new_spans = [sp for sp in lg.spans[before:]
+                 if sp.kind == ps.PERF_REGRESS]
+    assert {sp.attrs["rung"] for sp in new_spans} == \
+        {"serial_step", "aot_ttfc"}
+
+    # Honest re-run: green again (the regress row joins the history but
+    # the rolling MEDIAN baseline shrugs off one bad row).
+    assert _run_sentinel(ps, monkeypatch, out) == 0
+    assert ps.load_history(out)[-1]["verdicts"]["serial_step"] == "ok"
+
+
+def test_sentinel_judge_tolerance_boundaries():
+    """The noise gate's edges: within (1+tol)x passes, past it fails,
+    in BOTH directions; <3 prior rows is always 'baseline'."""
+    ps = _load_sentinel()
+    hist = [{"kind": "bench",
+             "rungs": {"r_hi": {"value": 100.0}, "r_lo": {"value": 4.0}}}
+            for _ in range(3)]
+    cur = {"r_hi": {"value": 51.0, "direction": "higher"},
+           "r_lo": {"value": 7.9, "direction": "lower"}}
+    v = ps.judge(cur, hist, 100.0)
+    assert v["r_hi"]["verdict"] == "ok" and v["r_lo"]["verdict"] == "ok"
+    cur_bad = {"r_hi": {"value": 49.0, "direction": "higher"},
+               "r_lo": {"value": 8.1, "direction": "lower"}}
+    v = ps.judge(cur_bad, hist, 100.0)
+    assert v["r_hi"]["verdict"] == "regress"
+    assert v["r_lo"]["verdict"] == "regress"
+    v = ps.judge(cur_bad, hist[:2], 100.0)
+    assert all(x["verdict"] == "baseline" for x in v.values())
+    # Tighter tolerance flips the 'ok' pair.
+    v = ps.judge(cur, hist, 10.0)
+    assert v["r_hi"]["verdict"] == "regress"
+    assert v["r_lo"]["verdict"] == "regress"
+
+
+@pytest.mark.slow
+def test_sentinel_real_measurement_subprocess(tmp_path):
+    """One REAL rung through the unpatched measurement path: subprocess
+    run of scripts/perf_sentinel.py on serial_step appends a history row
+    with a positive rate (slow: pays a cold compile on a fresh cache)."""
+    out = str(tmp_path / "history.ndjson")
+    env = dict(os.environ, PYTHONPATH=REPO, BENCH_SENTINEL_RUNGS="serial_step")
+    r = subprocess.run([sys.executable, SENTINEL, "--out", out,
+                        "--reps", "1"],
+                       capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rows = tledger.read_ndjson(out)
+    assert len(rows) == 1
+    rung = rows[0]["rungs"]["serial_step"]
+    assert rung["value"] > 0 and rung["unit"] == "events/s"
+    assert rows[0]["verdicts"]["serial_step"] == "baseline"
+
+
+def test_observatory_inert_on_compiled_graphs(tmp_path, monkeypatch):
+    """The whole observability layer is host-only BY CONSTRUCTION —
+    prove it: both engines' chunk scans trace to eqn-identical jaxprs
+    with the observatory armed (env knob set, a live store ingesting
+    mid-trace) and without it.  The census budgets and DONATION pins
+    ride the unchanged graphs (gated elsewhere in tier-1)."""
+    path = _write_fleet_stream(str(tmp_path / "fleet.ndjson"),
+                               [_digest_row(0, 0.1, 10)])
+
+    def sig(engine, kw):
+        p = SimParams(max_clock=100, **kw)
+        st = engine.init_batch(p, np.arange(2, dtype=np.uint32))
+        cj = jax.make_jaxpr(engine.make_scan_fn(p, 2))(st)
+        return GL.eqn_signature(cj.jaxpr)
+
+    off = [sig(S, GL.MICRO_SER_KW), sig(PE, GL.MICRO_LANE_KW)]
+    monkeypatch.setenv(tobs.WINDOW_ENV, "0.5")
+    obs = tobs.from_paths([path])
+    obs.rollup()
+    on = [sig(S, GL.MICRO_SER_KW), sig(PE, GL.MICRO_LANE_KW)]
+    assert obs.final_digest() is not None
+    assert on == off
