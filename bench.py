@@ -140,6 +140,12 @@ if os.environ.get("BENCH_POD"):
     # on the ROADMAP tunnel checklist).
     os.environ.setdefault("BENCH_PLATFORM", "cpu")
 
+if os.environ.get("BENCH_RING") or os.environ.get("BENCH_RING_CHILD"):
+    # The ring-dispatch ladder is a CPU-lowering proxy by definition
+    # (virtual host devices measure poll amortization, not chip ev/s; the
+    # on-chip ring re-measure is a ROADMAP tunnel-checklist item).
+    os.environ.setdefault("BENCH_PLATFORM", "cpu")
+
 if (__name__ == "__main__" and not os.environ.get("BENCH_SUPERVISED")
         and not os.environ.get("BENCH_PLATFORM")):
     _supervise()  # never returns
@@ -949,7 +955,206 @@ def run_macro_ladder(out_path: str) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Device-dispatch ring ladder (BENCH_RING=1): host-vs-device A/B per depth.
+#
+# The double-buffered host loop pays one dispatch + one [D]-digest poll per
+# chunk; SimParams.wrap="device" (parallel/sharded.py) retires up to ring_k
+# chunks inside ONE dispatched outer program and egresses a [ring_k, 13]
+# digest ring once per outer call — polls-per-retired-chunk drops to 1/K on
+# non-halting horizons.  This ladder measures that claim per ring depth K,
+# with a wrap="host" A/B leg per rung (identical shape/steps), and lands
+# ttfc (admission-to-first-chunk, cold compile included) at each depth —
+# the admission-latency side of the ring tradeoff.  One subprocess per leg
+# (the fleet-ladder protocol).  CPU-proxy caveat: host polls are cheap
+# here; the poll-count collapse is the metric that transfers to the chip's
+# dispatch queue (on-chip rung on the ROADMAP tunnel checklist).
+# ---------------------------------------------------------------------------
+
+
+def _ring_child() -> dict:
+    """One ring-ladder leg (own process): cold run for ttfc, then a timed
+    run, both through the production ``run_sharded`` dispatch loop."""
+    import numpy as np
+    from librabft_simulator_tpu.core.types import SimParams
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+    from librabft_simulator_tpu.parallel import sharded
+    from librabft_simulator_tpu.sim import parallel_sim, simulator
+    from librabft_simulator_tpu.sim.simulator import dedupe_buffers
+    from librabft_simulator_tpu.telemetry import ledger as tledger
+
+    cfg = json.loads(os.environ["BENCH_RING_CHILD"])
+    k, wrap, dp = int(cfg["k"]), cfg["wrap"], int(cfg["dp"])
+    engine_name = cfg.get("engine", "serial")
+    engine = parallel_sim if engine_name == "parallel" else simulator
+    b_per = int(os.environ.get("BENCH_RING_B", 64))
+    chunk = int(os.environ.get("BENCH_RING_STEPS", 8))
+    chunks = int(os.environ.get("BENCH_RING_CHUNKS", 64))
+    n_nodes = int(os.environ.get("BENCH_NODES", 4))
+    batch = b_per * dp
+    p = SimParams(n_nodes=n_nodes, delay_kind="uniform",
+                  queue_cap=max(32, 4 * n_nodes), epoch_handoff=False,
+                  max_clock=2**30, wrap=wrap,
+                  **({"ring_k": k} if wrap == "device" else {}))
+    mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1, devices=jax.devices()[:dp])
+    st = dedupe_buffers(engine.init_batch(p, sharded.fleet_seeds(0, batch)))
+    lg = tledger.get()
+    # Cold leg: one chunk end-to-end — ttfc is admission-to-first-chunk
+    # at this ring depth, XLA compile included (the admission-boundary
+    # latency a serve operator pays after arming LIBRABFT_SERVE_RING_K).
+    st = sharded.run_sharded(p, mesh, st, num_steps=chunk, chunk=chunk,
+                             engine=engine)
+    cold = lg.pipeline_stats()
+    e0 = int(np.sum(jax.device_get(st.n_events)))
+    t0 = time.perf_counter()
+    st = sharded.run_sharded(p, mesh, st, num_steps=chunk * chunks,
+                             chunk=chunk, engine=engine)
+    jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+    dt = time.perf_counter() - t0
+    e1 = int(np.sum(jax.device_get(st.n_events)))
+    pipe = lg.pipeline_stats()
+    ring = lg.ring_stats()
+    row = {
+        "k": k, "wrap": wrap, "dp": dp, "engine": engine_name,
+        "instances": batch, "chunk_steps": chunk, "chunks": chunks,
+        "events_per_sec": round((e1 - e0) / dt, 1),
+        "elapsed_s": round(dt, 3),
+        "time_to_first_chunk_s": cold.get("time_to_first_chunk_s"),
+        # Host wrap: one outer call (dispatch+poll) per chunk.
+        "dispatches": ring["dispatches"] if ring else pipe["chunks"],
+        # Host wrap: one poll per retired chunk by construction.
+        "polls_per_retired_chunk": (
+            ring["polls_per_retired_chunk"] if ring else 1.0),
+        "retired_per_dispatch": (
+            ring["retired_per_dispatch"] if ring else 1.0),
+        "ring_full": ring["ring_full"] if ring else None,
+        "early_exit": ring["early_exit"] if ring else None,
+    }
+    return row
+
+
+def run_ring_ladder(out_path: str) -> dict:
+    """Drive one subprocess per (K, wrap) leg; write RUNTIME_LEDGER_r14."""
+    from librabft_simulator_tpu.telemetry import ledger as tledger
+
+    try:
+        depths = [int(x) for x in
+                  os.environ.get("BENCH_RING_KS", "1,4,16,64").split(",")
+                  if x.strip()]
+    except ValueError:
+        print("bench: ignoring malformed BENCH_RING_KS", file=sys.stderr)
+        depths = [1, 4, 16, 64]
+    base_flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+
+    def run_child(cfg: dict):
+        # LIBRABFT_AOT=0 + LIBRABFT_COMPILE_CACHE=0: the round-13 store
+        # and the shared /tmp/jax_cache would warm whichever legs happen
+        # to share a cached executable (the host twin's program is
+        # K-independent), skewing the cross-depth ttfc comparison — every
+        # leg pays its own uniform cold compile instead.
+        env = dict(os.environ, BENCH_PLATFORM="cpu", LIBRABFT_AOT="0",
+                   LIBRABFT_COMPILE_CACHE="0",
+                   BENCH_RING_CHILD=json.dumps(cfg),
+                   XLA_FLAGS=(base_flags +
+                              " --xla_force_host_platform_device_count="
+                              f"{max(cfg['dp'], 1)}").strip())
+        env.pop("BENCH_RING", None)
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True)
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        try:
+            return json.loads(line), None
+        except ValueError:
+            return None, f"rc={r.returncode}: {(r.stderr or line)[-300:]}"
+
+    # Per depth: a device leg and its host A/B twin (identical shape and
+    # step budget — only the dispatch wrap differs), dp=1; plus one
+    # 2-shard device/host pair at the middle depth (the sharded leg of
+    # the bit-identity acceptance tests, measured too).
+    legs = []
+    for k in depths:
+        legs += [dict(k=k, wrap="device", dp=1),
+                 dict(k=k, wrap="host", dp=1)]
+    mid = depths[len(depths) // 2] if depths else 4
+    legs += [dict(k=mid, wrap="device", dp=2),
+             dict(k=mid, wrap="host", dp=2)]
+    rows, failures = [], {}
+    for cfg in legs:
+        row, err = run_child(cfg)
+        if row is None:
+            failures[f"k{cfg['k']}_{cfg['wrap']}_dp{cfg['dp']}"] = err
+            print(f"bench: ring leg {cfg} failed ({err[:120]})",
+                  file=sys.stderr)
+            continue
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+    host_ttfc = next((r["time_to_first_chunk_s"] for r in rows
+                      if r["wrap"] == "host" and r["dp"] == 1), None)
+    for r in rows:
+        r["ttfc_vs_host_s"] = (
+            round(r["time_to_first_chunk_s"] - host_ttfc, 3)
+            if host_ttfc is not None
+            and r["time_to_first_chunk_s"] is not None else None)
+    art = {
+        "kind": "runtime_ledger",
+        "flavor": "ring_dispatch",
+        "ledger_version": tledger.LEDGER_VERSION,
+        "platform": "cpu",
+        "emulated": True,
+        "time_to_first_chunk_s": host_ttfc,
+        "note": "device-dispatch ring ladder (SimParams.wrap='device'): "
+                "per ring depth K, a device leg and a wrap='host' A/B "
+                "twin at identical shape/steps.  "
+                "polls_per_retired_chunk = host digest fetches per "
+                "retired chunk (1/K target on non-halting horizons; "
+                "1.0 on the host wrap by construction); "
+                "time_to_first_chunk_s = admission to the first chunk "
+                "digest on host, cold XLA compile included (AOT store "
+                "and persistent compile cache disarmed in ladder "
+                "children so every leg is uniformly cold) — the "
+                "admission-boundary latency a ring-armed serve session "
+                "pays (LIBRABFT_SERVE_RING_K); ttfc_vs_host_s = that "
+                "minus the dp=1 host leg.  CPU-lowering proxy: the "
+                "poll-count collapse is the metric that transfers to "
+                "chip; on-chip rung on the ROADMAP tunnel checklist.",
+        "rungs": rows,
+        "failures": failures,
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"bench: wrote ring-ladder artifact {out_path}", file=sys.stderr)
+    dev = [r for r in rows if r["wrap"] == "device" and r["dp"] == 1]
+    best = min(dev, key=lambda r: r["polls_per_retired_chunk"]) \
+        if dev else None
+    head = {
+        "metric": "ring_polls_per_retired_chunk",
+        "value": best["polls_per_retired_chunk"] if best else None,
+        "unit": "host polls per retired chunk (device wrap, dp=1)",
+        "k": best["k"] if best else None,
+        "poll_curve": {f"k{r['k']}": r["polls_per_retired_chunk"]
+                       for r in dev},
+        "ttfc_curve_s": {f"k{r['k']}": r["time_to_first_chunk_s"]
+                         for r in dev},
+        "host_ttfc_s": host_ttfc,
+        "artifact": out_path,
+    }
+    print(json.dumps(head))
+    return art
+
+
 def main():
+    if os.environ.get("BENCH_RING_CHILD"):
+        print(json.dumps(_ring_child()))
+        return
+    if os.environ.get("BENCH_RING"):
+        art = run_ring_ladder(os.environ.get("BENCH_RING_OUT",
+                                             "RUNTIME_LEDGER_r14.json"))
+        # A ladder with missing legs is a broken A/B, not a success.
+        if art["failures"] or not art["rungs"]:
+            sys.exit(1)
+        return
     if os.environ.get("BENCH_POD"):
         # The multi-process pod ladder (scripts/fleet_pod.py): each rung
         # is its own jax.distributed job, so the harness runs in a fresh

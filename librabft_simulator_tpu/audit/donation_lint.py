@@ -56,8 +56,8 @@ from .source_lint import Finding, _attr_chain, _functions, \
 #: DONATION must pin exactly this set (tests/test_audit.py checks).
 DONATION_FLAVORS = (
     "serial/run", "serial/digest", "serial/telemetry", "serial/scenario",
-    "lane/digest", "sharded/digest", "sharded/scenario", "serve/install",
-    "sanitize/serial")
+    "lane/digest", "sharded/digest", "sharded/ring", "sharded/scenario",
+    "serve/install", "sanitize/serial")
 
 #: D2: donation-adjacent modules — everything that stages host trees onto
 #: the mesh a donating runner consumes (package-relative, plus the serve
@@ -265,6 +265,7 @@ def audit_donation(shape: str = "micro") -> tuple[list[Finding], dict]:
         mesh = mesh_ops.make_mesh(n_dp=2, n_mp=1,
                                   devices=jax.devices()[:2])
         for name, kw in (("sharded/digest", {}),
+                         ("sharded/ring", dict(wrap="device", ring_k=4)),
                          ("sharded/scenario", dict(scenario=True))):
             p = xops.resolve_params(
                 SimParams(**ser_kw, **GL.TPU_FORMS, **kw))
@@ -275,10 +276,14 @@ def audit_donation(shape: str = "micro") -> tuple[list[Finding], dict]:
                 from ..core import types as core_types
                 key_p = dc.replace(key_p, commit_chain=3,
                                    **core_types.DELAY_KEY_DEFAULTS)
+            # The ring runner takes (state, cap): the state is donated,
+            # the host's chunk-budget scalar NEVER is.
+            args = ((st, jnp.int32(1)) if key_p.wrap == "device"
+                    else (st,))
             run_check(name,
                       sharded._cached_sharded_run_fn(
                           key_p, mesh, steps, S, "shard_map"),
-                      (st,), 0)
+                      args, 0)
 
         # The admission write: state donated, mask and donor NEVER (the
         # static pin that makes _admit's undeduped donor placement safe —

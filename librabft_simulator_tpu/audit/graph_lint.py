@@ -40,7 +40,10 @@ R5  **Digest-only host contract.**  The sharded chunk runner's only
     small (host-fetched) output is the ``[DIGEST_WIDTH]`` int32 digest;
     every other output is a fleet-sized state leaf (leading dim = padded
     batch).  This is the static form of the monkeypatched-``device_get``
-    test in tests/test_multichip.py.
+    test in tests/test_multichip.py.  The device dispatch wrap
+    (``SimParams.wrap="device"``) gets its own arm: the ring runner's
+    only small outputs are ONE ``[ring_k, DIGEST_WIDTH]`` int32 digest
+    ring plus ONE scalar int retired count (:func:`check_r5_ring`).
 R6  **Knob-off graph equality.**  With telemetry/watchdog off the graph
     must be *structurally identical* to the baseline — checked in its
     strongest form: the knob-ON graph, dead-code-eliminated to its
@@ -50,6 +53,9 @@ R6  **Knob-off graph equality.**  With telemetry/watchdog off the graph
     bit-identity tests into a static guarantee.  For ``mp_authors``: the
     off graph must contain zero 'mp'-axis collectives inside the chunk
     scan, and the armed (n_mp=1) graph must contain the quorum psums.
+    For the dispatch wrap: ``wrap="host"`` must trace eqn-identical to
+    an inline-built pre-ring twin (:func:`check_r6_ring`) — the device
+    ring is a sibling branch, never a wrapper on the default path.
 
 Waivers: ``R1_WAIVERS`` maps (package-relative file) -> justification for
 *vector*-class traced-index writes.  Scalar-class hits cannot be waived.
@@ -504,6 +510,7 @@ def trace_sharded(p: SimParams, batch: int, dp: int):
     from ..parallel import mesh as mesh_ops
     from ..parallel import sharded
     from ..sim import simulator as S
+    from ..utils import xops
 
     mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1, devices=jax.devices()[:dp])
     st = S.init_batch(p, sharded.fleet_seeds(0, batch))
@@ -511,6 +518,9 @@ def trace_sharded(p: SimParams, batch: int, dp: int):
     padded_b = sharded.batch_size(st)
     st = mesh_ops.shard_batch(mesh, st)
     run = sharded.make_sharded_run_fn(p, mesh, 2)
+    if xops.resolve_params(p).wrap == "device":
+        # The ring runner takes the traced chunk-budget scalar too.
+        return jax.make_jaxpr(run)(st, jnp.int32(1)), padded_b
     return jax.make_jaxpr(run)(st), padded_b
 
 
@@ -543,6 +553,110 @@ def check_r5(cj, padded_b: int, flavor: str) -> list[Finding]:
                 f"non-state, non-digest output {a}: every extra output "
                 "is another per-chunk host transfer candidate", ""))
     return findings
+
+
+def check_r5_ring(cj, padded_b: int, ring_k: int,
+                  flavor: str) -> list[Finding]:
+    """R5's ring arm (``SimParams.wrap="device"``): the only SMALL
+    outputs of the ring runner are ONE ``[ring_k, 13]`` int digest ring
+    and ONE scalar int retired count — everything else must be
+    fleet-sized, exactly the host-flavor contract one level up (the
+    outer call's egress is the ring + count, never a per-chunk or
+    non-batch extra)."""
+    findings = []
+    if DIGEST_WIDTH != tstream.DIGEST_WIDTH:
+        findings.append(Finding(
+            "R5", flavor, "error",
+            f"digest width changed: telemetry/stream.DIGEST_WIDTH="
+            f"{tstream.DIGEST_WIDTH} vs the audited contract "
+            f"{DIGEST_WIDTH} — re-pin BOTH after bumping "
+            "REGISTRY_VERSION", ""))
+    outs = [v.aval for v in cj.jaxpr.outvars]
+
+    def is_ring(a):
+        return (tuple(a.shape) == (ring_k, tstream.DIGEST_WIDTH)
+                and np.dtype(a.dtype).kind == "i")
+
+    def is_count(a):
+        return not a.shape and np.dtype(a.dtype).kind == "i"
+
+    if sum(1 for a in outs if is_ring(a)) != 1:
+        findings.append(Finding(
+            "R5", flavor, "error",
+            f"ring runner must return exactly one [{ring_k}, "
+            f"{DIGEST_WIDTH}] int32 digest ring "
+            f"(found {sum(1 for a in outs if is_ring(a))}) — the "
+            "one-egress-per-outer-call contract of "
+            "parallel/sharded.run_sharded's device wrap", ""))
+    if sum(1 for a in outs if is_count(a)) != 1:
+        findings.append(Finding(
+            "R5", flavor, "error",
+            f"ring runner must return exactly one scalar int retired "
+            f"count (found {sum(1 for a in outs if is_count(a))})", ""))
+    for a in outs:
+        if is_ring(a) or is_count(a):
+            continue
+        if not a.shape or a.shape[0] != padded_b:
+            findings.append(Finding(
+                "R5", flavor, "error",
+                f"non-state, non-ring output {a}: every extra output "
+                "is another per-outer-call host transfer candidate", ""))
+    return findings
+
+
+def check_r6_ring(p_base: SimParams, batch: int, dp: int,
+                  cj_off=None) -> list[Finding]:
+    """The ring knob's R6 arm: ``wrap="host"`` must stay the EXACT
+    pre-ring graph.  The HEAD twin — shard_map(scan + digest) built
+    inline here, bypassing make_sharded_run_fn's wrap dispatch — must
+    trace eqn-identical to the audited host runner, so the device wrap
+    can only ever be a sibling branch, never a wrapper that grows the
+    default path (the macro-k1-identity pin one level up)."""
+    import dataclasses as _dc
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as _P
+
+    from ..core import types as _types
+    from ..parallel import mesh as mesh_ops
+    from ..parallel import sharded
+    from ..sim import simulator as S
+    from ..utils import xops
+
+    if cj_off is None:
+        cj_off, _ = trace_sharded(
+            _dc.replace(p_base, wrap="host"), batch, dp)
+    # The twin normalizes params exactly as make_sharded_run_fn does
+    # (resolve + runtime-field normalization) so the two traces differ
+    # only if the HOST BRANCH itself drifted.
+    key_p = _dc.replace(xops.resolve_params(p_base), max_clock=0,
+                        drop_prob=0.0)
+    if key_p.scenario:
+        key_p = _dc.replace(key_p, commit_chain=3,
+                            **_types.DELAY_KEY_DEFAULTS)
+    key_p = _dc.replace(key_p, wrap="host", ring_k=None)
+    mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1, devices=jax.devices()[:dp])
+    st = S.init_batch(key_p, sharded.fleet_seeds(0, batch))
+    st, _ = sharded.pad_to_multiple(key_p, st, mesh.size)
+    st = mesh_ops.shard_batch(mesh, st)
+    axes = tuple(mesh.axis_names)
+    inner = S.make_scan_fn(key_p, 2, batched=True)
+
+    def local(s):
+        s = inner(s)
+        return s, tstream.compute_digest(key_p, s, axis_names=axes)
+
+    f = shard_map(local, mesh=mesh, in_specs=(_P(axes),),
+                  out_specs=(_P(axes), _P()), check_rep=False)
+    cj_twin = jax.make_jaxpr(jax.jit(f, donate_argnums=(0,)))(st)
+    if eqn_signature(cj_twin.jaxpr) != eqn_signature(cj_off.jaxpr):
+        return [Finding(
+            "R6", "sharded/wrap_host", "error",
+            "wrap='host' is no longer the exact pre-ring graph: the "
+            "host-dispatch runner's trace differs from the inline "
+            "shard_map(scan + digest) twin — the device-wrap branch "
+            "leaked into the default path", "")]
+    return []
 
 
 _COLLECTIVES = ("psum", "pmax", "pmin", "all_gather", "all_reduce",
@@ -817,6 +931,21 @@ def audit_sharded(base_kw: dict, batch: int = 5, dp: int = 2,
         "padded_batch": padded_b,
         "outputs": len(cj.jaxpr.outvars),
     }}
+    # Device dispatch wrap: the ring flavor's R5 arm (only small outputs
+    # = one [K, 13] ring + one retired count) and the R6 arm pinning
+    # wrap="host" graph-identical to the pre-ring runner.
+    ring_k = 4
+    p_ring = dataclasses.replace(p, wrap="device", ring_k=ring_k)
+    cj_r, padded_r = trace_sharded(p_ring, batch, dp)
+    findings += check_r5_ring(cj_r, padded_r, ring_k, "sharded/ring_k4")
+    findings += check_r3(cj_r.jaxpr, "sharded/ring_k4")
+    findings += check_r6_ring(p, batch, dp, cj_off=cj)
+    stats["sharded/ring_k4"] = {
+        "eqns": sum(1 for _ in iter_eqns(cj_r.jaxpr)),
+        "eqn_hash": signature_hash(cj_r.jaxpr),
+        "padded_batch": padded_r,
+        "outputs": len(cj_r.jaxpr.outvars),
+    }
     return findings, stats
 
 

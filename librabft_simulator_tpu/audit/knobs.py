@@ -51,6 +51,19 @@ KNOBS: tuple[Knob, ...] = (
          "A/B override for the serial engine's K-event macro-steps "
          "(SimParams.macro_k=None resolves env->K, else 1; each "
          "dispatched step retires K events, bit-identically)."),
+    Knob("LIBRABFT_WRAP", "engine", "utils/xops.py", "host|device",
+         "A/B override for the fleet dispatch wrap (SimParams.wrap=None "
+         "resolves env->mode, else 'host').  'device' wraps the chunk "
+         "scan in an in-graph while loop that retires up to ring_k "
+         "chunks per dispatched outer program, streaming each chunk's "
+         "[13] digest into a device-side ring — one host egress per "
+         "outer call instead of per chunk, bit-identically."),
+    Knob("LIBRABFT_RING_K", "engine", "utils/xops.py", "int >= 1",
+         "A/B override for the device-dispatch ring depth "
+         "(SimParams.ring_k=None resolves env->K, else 16).  Only "
+         "meaningful under wrap='device' (normalized out of host-wrap "
+         "compile keys); a compile key — the [K,13] ring shape is baked "
+         "into the outer program."),
     Knob("LIBRABFT_CHECKIFY", "engine", "audit/sanitize.py", "0|1",
          "Debug: run_to_completion runs the checkify-instrumented chunk "
          "(state-invariant + div checks) and raises on the first trip; "
@@ -92,6 +105,13 @@ KNOBS: tuple[Knob, ...] = (
          "Stream the service's digest + request-lifecycle NDJSON here "
          "(admission queue depth, slot occupancy, per-request ttfc); "
          "follow live with scripts/fleet_watch.py --serve."),
+    Knob("LIBRABFT_SERVE_RING_K", "engine", "serve/api.py", "int >= 1",
+         "Resident fleet service: arm the device dispatch wrap at this "
+         "ring depth — admission and egress then land at outer-call "
+         "boundaries (up to ring_k chunks apart), trading admission "
+         "latency for up-to-ring_k-fewer host polls per retired chunk "
+         "(RUNTIME_LEDGER_r14 quantifies the tradeoff).  Unset: the "
+         "base params' own wrap/ring_k resolution decides."),
     Knob("LIBRABFT_DIST_COORD", "engine", "distributed/bootstrap.py",
          "host:port",
          "Multi-process fleet: the jax.distributed coordinator address "
@@ -197,6 +217,24 @@ KNOBS: tuple[Knob, ...] = (
          "rung runs a second cold process with LIBRABFT_AOT=0, landing "
          "ttfc_aot (store-loaded) vs ttfc_jit (trace+lower+compile) in "
          "the RUNTIME_LEDGER artifact.  0 = production leg only."),
+    Knob("BENCH_RING", "bench", "bench.py", "1",
+         "Run the device-dispatch ring ladder (one subprocess per rung): "
+         "host-vs-device A/B at each ring depth in BENCH_RING_KS — "
+         "ttfc, polls-per-retired-chunk, ev/s per rung — writing the "
+         "RUNTIME_LEDGER_r14 artifact (CPU-lowering proxy)."),
+    Knob("BENCH_RING_CHILD", "bench", "bench.py", "json",
+         "Internal: marks a ring-ladder rung child (k/wrap/dp/engine)."),
+    Knob("BENCH_RING_KS", "bench", "bench.py", "k1,k2,...",
+         "Ring-ladder depths (default 1,4,16,64)."),
+    Knob("BENCH_RING_B", "bench", "bench.py", "int",
+         "Ring ladder: instances per shard (default 64)."),
+    Knob("BENCH_RING_STEPS", "bench", "bench.py", "int",
+         "Ring ladder: macro-steps per chunk (default 8)."),
+    Knob("BENCH_RING_CHUNKS", "bench", "bench.py", "int",
+         "Ring ladder: timed chunks per rung (default 64; non-halting "
+         "horizon, so device rungs retire full caps)."),
+    Knob("BENCH_RING_OUT", "bench", "bench.py", "path",
+         "Ring-ladder artifact path (default RUNTIME_LEDGER_r14.json)."),
     Knob("BENCH_POD", "bench", "bench.py", "1",
          "Run the multi-process pod ladder (scripts/fleet_pod.py): "
          "1/2/4 REAL jax.distributed processes over a loopback "
@@ -291,7 +329,7 @@ KNOBS: tuple[Knob, ...] = (
          "name,name,...",
          "Perf sentinel: comma-separated subset of the canonical rung "
          "matrix (serial_step lane_step fleet_chunk macro_k16 aot_ttfc "
-         "serve_admit; default all)."),
+         "serve_admit ring_dispatch; default all)."),
     Knob("BENCH_SENTINEL_TOL_PCT", "script", "scripts/perf_sentinel.py",
          "float > 0",
          "Perf sentinel: regression tolerance in percent over the "

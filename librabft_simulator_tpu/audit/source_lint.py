@@ -159,6 +159,11 @@ SANCTIONED_SYNCS = {
         "THE poll path: the fleet loop's single blocking fetch — one [D] "
         "digest per chunk (pinned dynamically by test_multichip's "
         "monkeypatched device_get).",
+    ("parallel/sharded.py", "_poll_ring"):
+        "the device-wrap poll path (round 19): ONE blocking fetch of the "
+        "[ring_k, D] digest ring + retired count per OUTER call — up to "
+        "ring_k retired chunks amortize it (tledger ring_stats "
+        "polls_per_retired_chunk <= 1/K is the acceptance pin).",
     ("parallel/sharded.py", "pad_to_multiple"):
         "one-time host-side padding of a host (checkpoint-restored) "
         "fleet: filler is fetched once, outside the chunk loop.",

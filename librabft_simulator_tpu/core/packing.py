@@ -54,9 +54,17 @@ NODE_PARTS = ("store", "pm", "node", "ctx")
 
 
 def node_template(p: SimParams):
-    """Single-node template pytree (shape ``()`` per scalar leaf)."""
-    return (Store.initial(p), Pacemaker.initial(), NodeExtra.initial(),
-            Context.initial(p))
+    """Single-node template pytree (shape ``()`` per scalar leaf).
+
+    Built under ``ensure_compile_time_eval`` so the template leaves are
+    ALWAYS concrete constants: the initial-tag folds and broadcasts in
+    ``*.initial`` would otherwise be traced as dead eqns whenever a
+    caller's cache (``slot_map``) missed INSIDE a trace — making the
+    traced graph depend on cache temperature and trace order, which the
+    R6 graph-identity audits would flag as phantom drift."""
+    with jax.ensure_compile_time_eval():
+        return (Store.initial(p), Pacemaker.initial(), NodeExtra.initial(),
+                Context.initial(p))
 
 
 @functools.lru_cache(maxsize=None)

@@ -208,6 +208,31 @@ class SimParams:
     # engine only: the lane engine raises on macro_k > 1 (its windows
     # are the same amortization by other means).
     macro_k: int | None = None
+    # Dispatch wrap (parallel/sharded.py): who drives the chunk loop.
+    # "host" is the classic contract — one host dispatch + one blocking
+    # [13] digest fetch per chunk (the double-buffered run_sharded loop).
+    # "device" moves the loop in-graph: a ``lax.while_loop`` retires up
+    # to ``ring_k`` chunks per dispatched outer program, exits early on
+    # the all-halted predicate, and streams each retired chunk's digest
+    # into a device-side [ring_k, 13] int32 ring egressed ONCE per outer
+    # call — the host becomes a ring reader instead of a per-chunk
+    # poller (polls-per-retired-chunk drops from 1.0 to <= 1/ring_k on
+    # non-halting horizons).  Chunks are bit-identical between wraps
+    # (every engine write is live-gated, so extra iterations on halted
+    # fleets are exact no-ops — the same idiom that makes macro_k and
+    # pre-halted padding exact).  Static compile key; NOT the SPMD wrap
+    # argument of make_sharded_run_fn ("shard_map"/"jit") — this is one
+    # level up, the host-dispatch wrap.  None = auto: LIBRABFT_WRAP env
+    # override, else "host" (the exact pre-ring contract; pinned
+    # graph-identical by the audit's R6 ring arm).
+    wrap: str | None = None
+    # Digest-ring depth K for wrap="device": chunks retired per
+    # dispatched outer program, and the ring's first dimension.  Static
+    # compile key (the ring is a fixed-shape output).  None = auto:
+    # LIBRABFT_RING_K env override, else 16 when wrap resolves to
+    # "device".  Normalized to None when wrap resolves to "host" so the
+    # host flavor's compile/AOT keys never vary with LIBRABFT_RING_K.
+    ring_k: int | None = None
     # In-graph consensus watchdog (telemetry/stream.py): a per-instance
     # [WD] int32 plane of anomaly detectors — liveness stall (no pacemaker
     # round advance for ``watchdog_stall_events`` processed events),
@@ -273,6 +298,17 @@ class SimParams:
                 f"macro_k must be >= 1 (got {self.macro_k}); the serial "
                 "engine's dispatched unit retires macro_k events — zero "
                 "would dispatch empty programs forever")
+        if self.wrap is not None and self.wrap not in ("host", "device"):
+            raise ValueError(
+                f"wrap must be 'host' or 'device' (got {self.wrap!r}); "
+                "the dispatch wrap picks who drives the chunk loop — the "
+                "SPMD wrap ('shard_map'/'jit') is a separate "
+                "make_sharded_run_fn argument")
+        if self.ring_k is not None and self.ring_k < 1:
+            raise ValueError(
+                f"ring_k must be >= 1 (got {self.ring_k}); the device "
+                "dispatch wrap retires up to ring_k chunks per outer "
+                "call — a zero-depth ring could never retire a chunk")
         if self.watchdog and self.watchdog_stall_events < 1:
             raise ValueError(
                 f"watchdog_stall_events must be >= 1 when the watchdog is "

@@ -193,6 +193,22 @@ def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
     event/commit slots are in-state counters accumulated per inner
     iteration, never per-dispatch tallies).
 
+    **Dispatch wrap** (``SimParams.wrap``, resolved via
+    ``xops.resolve_params`` — NOT this function's SPMD ``wrap``
+    argument): with ``wrap="device"`` the chunk scan is additionally
+    wrapped in an in-graph ``lax.while_loop`` that retires up to
+    ``SimParams.ring_k`` chunks per dispatched outer program, exits
+    early on the all-halted predicate, and streams each retired chunk's
+    [D] digest into a device-side ``[ring_k, D]`` int32 ring.  The
+    runner's signature becomes ``(st, cap) -> (st, ring, retired)``
+    where ``cap`` is a TRACED scalar chunk budget (host clamps it to the
+    remaining step budget without a retrace) and ``retired`` counts the
+    ring rows actually written.  Chunk bodies are the identical graph,
+    so the ring flavor is bit-exact against ``wrap="host"`` per chunk
+    (tests/test_multichip.py); requires the shard_map SPMD form (the
+    halt predicate is the psum'd digest, replicated across shards, so
+    every shard's while loop takes the same trip count).
+
     The runner is memoized like the engines' ``_compiled_run``: params
     differing only in horizon/drop rate (which ride in SimState) share one
     executable; delay/duration-table variants re-trace, since the tables
@@ -231,18 +247,29 @@ def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
     if key_p.scenario:
         key_p = dataclasses.replace(
             key_p, commit_chain=3, **types.DELAY_KEY_DEFAULTS)
+    if key_p.wrap == "device" and wrap != "shard_map":
+        # The device wrap's while-loop halt predicate is the psum'd
+        # digest — uniform across shards only under shard_map's bound
+        # mesh axes.  The GSPMD "jit" A/B form stays host-dispatched.
+        raise ValueError(
+            "SimParams.wrap='device' requires the shard_map SPMD form "
+            f"(got wrap={wrap!r}); the in-graph ring loop's halt "
+            "predicate needs the mesh axes bound")
     inner = _cached_sharded_run_fn(key_p, mesh, num_steps, eng, wrap)
     eng_name = "sharded/" + ("lane" if eng is not sim_ops else "serial")
+    flavor = "ring" if key_p.wrap == "device" else "digest"
+    ring_meta = ({"ring_k": key_p.ring_k} if key_p.wrap == "device" else {})
     # AOT executable store (utils/aot.py): consult before tracing — see
     # simulator.make_run_fn.  Unlike the single-chip runners, the delay/
     # duration tables are BAKED into the sharded scan closure, so the
     # store key must carry the full normalized params (key_p), not just
     # structural() — two delay configs are two different executables
-    # here.  Mesh layout and wrap mode complete the key.
+    # here.  Mesh layout, SPMD wrap mode, and (for the device dispatch
+    # wrap) the ring depth complete the key.
     call = aot.wrap_jit(
         inner, (), key=tledger.params_key(key_p), engine=eng_name,
-        flavor="digest", num_steps=num_steps, wrap=wrap,
-        mesh=str(dict(mesh.shape)))
+        flavor=flavor, num_steps=num_steps, wrap=wrap,
+        mesh=str(dict(mesh.shape)), **ring_meta)
     # Compile ledger (telemetry/ledger.py): the sharded chunk executable
     # is recorded like the single-chip ones — keyed on the normalized
     # structural params + mesh + shapes, host-side only.
@@ -251,7 +278,7 @@ def make_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
         structural=repr(key_p.structural()),
         engine=eng_name,
         n_nodes=p.n_nodes, num_steps=num_steps, wrap=wrap,
-        mesh=str(dict(mesh.shape)))
+        mesh=str(dict(mesh.shape)), **ring_meta)
 
 
 @functools.lru_cache(maxsize=None)
@@ -260,6 +287,45 @@ def _cached_sharded_run_fn(p: SimParams, mesh: Mesh, num_steps: int,
     axes = tuple(mesh.axis_names)
     if wrap == "shard_map":
         inner = eng.make_scan_fn(p, num_steps, batched=True)
+        if p.wrap == "device":
+            ring_k = int(p.ring_k)
+            halted_slot = tstream.SLOT["halted"]
+
+            def local(st, cap):
+                # In-graph chunk retirement: retire up to ``cap`` chunks
+                # (cap <= ring_k, the host's remaining-budget clamp) or
+                # until the whole fleet halts, streaming each retired
+                # chunk's replicated [D] digest into a [ring_k, D] ring.
+                # The halt predicate reads the PREVIOUS chunk's psum'd
+                # digest, so every shard's loop takes the same trip
+                # count; halted=0 initially, so at least one chunk
+                # always retires (the host flavor's unconditional first
+                # dispatch).  Retiring a chunk on an already-halted
+                # fleet would be an exact no-op anyway (live-gated
+                # writes), which is what makes the two wraps bit-exact.
+                total = (jax.tree_util.tree_leaves(st)[0].shape[0]
+                         * mesh.size)
+                ring0 = jnp.zeros((ring_k, tstream.DIGEST_WIDTH), I32)
+
+                def cond(carry):
+                    _, _, retired, halted = carry
+                    return (retired < cap) & (halted < total)
+
+                def body(carry):
+                    st, ring, retired, _ = carry
+                    st = inner(st)
+                    dg = tstream.compute_digest(p, st, axis_names=axes)
+                    ring = jax.lax.dynamic_update_slice(
+                        ring, dg[None, :], (retired, 0))
+                    return st, ring, retired + 1, dg[halted_slot]
+
+                st, ring, retired, _ = jax.lax.while_loop(
+                    cond, body, (st, ring0, jnp.int32(0), jnp.int32(0)))
+                return st, ring, retired
+
+            f = shard_map(local, mesh=mesh, in_specs=(P(axes), P()),
+                          out_specs=(P(axes), P(), P()), check_rep=False)
+            return jax.jit(f, donate_argnums=(0,))
 
         def local(st):
             st = inner(st)
@@ -296,6 +362,16 @@ def _poll_digest(dg) -> np.ndarray:
     return np.asarray(jax.device_get(dg))
 
 
+def _poll_ring(ring, retired) -> tuple[np.ndarray, int]:
+    """Blocking host fetch of one outer call's ``[ring_k, D]`` digest ring
+    plus its retired-chunk count — the device wrap's ONE egress per up-to-
+    ring_k retired chunks (vs one :func:`_poll_digest` per chunk on the
+    host wrap).  Split out, like ``_poll_digest``, so tests can
+    monkeypatch/count exactly the ring fetches."""
+    ring_h, n = jax.device_get((ring, retired))
+    return np.asarray(ring_h), int(n)
+
+
 def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
                 chunk: int = 256, engine=None, pipeline: bool = True,
                 wrap: str = "shard_map", pad: bool = True, stream=None):
@@ -323,7 +399,20 @@ def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
     digest — the live fleet-health timeline costs ZERO additional host
     syncs because the digest IS the halt poll.  Every dispatched chunk is
     polled exactly once (the final in-flight chunk included), so the
-    timeline always ends on the fleet's true final digest."""
+    timeline always ends on the fleet's true final digest.
+
+    **Device dispatch wrap** (``SimParams.wrap="device"``, resolved via
+    ``xops.resolve_params``): the loop above moves in-graph — each outer
+    call retires up to ``SimParams.ring_k`` chunks (clamped to the
+    remaining step budget via a traced ``cap`` scalar, no retrace) and
+    the host fetches the ``[ring_k, D]`` digest ring ONCE per outer
+    call, so polls-per-retired-chunk drops from 1.0 to <= 1/ring_k on
+    non-halting horizons.  The outer loop is sequential (``pipeline`` is
+    ignored: the in-graph early exit makes speculative double-buffering
+    dispatch up to ring_k no-op chunks).  Every retired chunk's digest
+    still reaches ``stream`` in order with true per-chunk counts, and
+    trajectories stay bit-identical to ``wrap="host"`` — the chunk
+    graph is shared, only the dispatch wrap differs."""
     eng = engine if engine is not None else sim_ops
     n_valid = batch_size(state)
     if pad:
@@ -341,10 +430,11 @@ def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
     if stream is not None:
         stream.set_fleet(total=b_total, n_valid=n_valid)
     halted_slot = tstream.SLOT["halted"]
+    rp = xops.resolve_params(p)
     # Serial-engine macro-steps: the recorder's `steps` metadata stays
     # per-instance EVENT-steps (each dispatched step retires k events);
     # the digest's own counters are true in-state values regardless.
-    k = sim_ops.macro_k_of(xops.resolve_params(p)) if eng is sim_ops else 1
+    k = sim_ops.macro_k_of(rp) if eng is sim_ops else 1
     # Runtime ledger (telemetry/ledger.py): per-chunk dispatch-enqueue vs
     # blocking-poll spans, from which pipeline_stats measures the
     # double-buffered loop's overlap fraction, dispatch-queue bubbles,
@@ -352,7 +442,38 @@ def run_sharded(p: SimParams, mesh: Mesh, state, num_steps: int,
     # one-[D]-fetch poll contract are untouched.
     lg = tledger.get()
     rid = lg.new_run("run_sharded", devices=mesh.size, instances=b_total,
-                     pipeline=bool(pipeline), chunk_steps=chunk)
+                     pipeline=bool(pipeline), chunk_steps=chunk,
+                     dispatch_wrap=rp.wrap,
+                     **({"ring_k": rp.ring_k} if rp.wrap == "device"
+                        else {}))
+
+    if rp.wrap == "device":
+        # Ring dispatch: one outer call retires up to ring_k chunks
+        # in-graph; the host reads the digest ring once per call.  The
+        # POLL span carries ``retired``/``cap`` so ledger.ring_stats can
+        # report retired-per-dispatch and polls-per-retired-chunk.
+        ring_k = int(rp.ring_k)
+        done, ci, oi = 0, 0, 0
+        while done < num_steps:
+            cap = min(ring_k, -((done - num_steps) // chunk))
+            with lg.span(tledger.DISPATCH, run=rid, chunk=ci, outer=oi,
+                         cap=cap):
+                state, ring, retired = run(state, np.int32(cap))
+            with lg.span(tledger.POLL, run=rid, chunk=ci, outer=oi,
+                         cap=cap) as sp:
+                rows, n = _poll_ring(ring, retired)
+                sp.attrs["retired"] = n
+            if stream is not None:
+                stream.record_ring(
+                    rows, n,
+                    steps=[(ci + i + 1) * chunk * k for i in range(n)])
+            done += n * chunk
+            ci += n
+            oi += 1
+            if int(rows[n - 1][halted_slot]) >= b_total:
+                break
+        with lg.span(tledger.HOST_MERGE, run=rid):
+            return unpad(state, n_valid)
 
     def poll(dg, done_steps, chunk_i) -> bool:
         with lg.span(tledger.POLL, run=rid, chunk=chunk_i):

@@ -39,6 +39,12 @@ from .service import ResidentFleet
 SLOTS_ENV = "LIBRABFT_SERVE_SLOTS"
 CHUNK_ENV = "LIBRABFT_SERVE_CHUNK"
 OUT_ENV = "LIBRABFT_SERVE_OUT"
+#: Serve ring depth: arms the device dispatch wrap (SimParams.wrap=
+#: "device") on the resident fleet at this ring_k — admission/egress
+#: then land only at outer-call boundaries (up to ring_k chunks apart),
+#: trading admission latency for up-to-ring_k-fewer host polls per
+#: retired chunk.  Unset = the base params' own wrap/ring_k resolution.
+RING_ENV = "LIBRABFT_SERVE_RING_K"
 
 
 def _int_env(name: str, default: int) -> int:
@@ -98,16 +104,20 @@ class FleetService:
 
     def __init__(self, base_params: SimParams | None = None,
                  slots: int | None = None, chunk: int | None = None,
-                 mesh=None, engine=None, out: str | None = None):
+                 mesh=None, engine=None, out: str | None = None,
+                 ring_k: int | None = None):
         self.p = base_params if base_params is not None else SimParams(
             n_nodes=4)
+        if ring_k is None and os.environ.get(RING_ENV, "").strip():
+            ring_k = _int_env(RING_ENV, 0)
         self.fleet = ResidentFleet(
             self.p,
             slots=slots if slots is not None else _int_env(SLOTS_ENV, 8),
             chunk=chunk if chunk is not None else _int_env(CHUNK_ENV, 64),
             mesh=mesh, engine=engine,
             out=out if out is not None else (os.environ.get(OUT_ENV)
-                                             or None))
+                                             or None),
+            ring_k=ring_k)
 
     def submit(self, spec, request_id: str | None = None) -> str:
         return self.fleet.submit(spec, request_id=request_id)

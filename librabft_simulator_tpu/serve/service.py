@@ -50,6 +50,7 @@ from ..sim import simulator as sim_ops
 from ..telemetry import ledger as tledger
 from ..telemetry import schema as tschema
 from ..telemetry import stream as tstream
+from ..utils import xops
 from . import scenario as sc
 
 #: Per-serve-call chunk ceiling: a runaway scenario (horizon never reached
@@ -103,9 +104,22 @@ class ResidentFleet:
 
     def __init__(self, p: SimParams, slots: int = 8, mesh=None,
                  chunk: int = 64, engine=None, out=None, meta=None,
-                 fresh_state: bool = True):
+                 fresh_state: bool = True, ring_k: int | None = None):
         self.engine = engine if engine is not None else sim_ops
         self.p = dataclasses.replace(p, scenario=True)
+        # Ring-depth serve knob: an explicit ``ring_k`` arms the device
+        # dispatch wrap (SimParams.wrap="device") at that depth; without
+        # it the base params' own wrap/ring_k resolution (incl. the
+        # LIBRABFT_WRAP / LIBRABFT_RING_K envs) decides.  Under the
+        # device wrap, admission/egress land only at OUTER-CALL
+        # boundaries — up to ring_k chunks between boundaries — so a
+        # deeper ring buys fewer host polls at the cost of admission
+        # latency (the BENCH_RING serve rungs quantify the tradeoff).
+        if ring_k is not None:
+            self.p = dataclasses.replace(self.p, wrap="device",
+                                         ring_k=int(ring_k))
+        rp = xops.resolve_params(self.p)
+        self._ring_k = rp.ring_k if rp.wrap == "device" else None
         self.mesh = mesh if mesh is not None else mesh_ops.make_mesh(n_dp=1)
         self.slots = -(-slots // self.mesh.size) * self.mesh.size
         self.chunk = int(chunk)
@@ -172,7 +186,9 @@ class ResidentFleet:
         self._lg = tledger.get()
         self._rid = self._lg.new_run(
             "resident_fleet", devices=self.mesh.size, instances=self.slots,
-            pipeline=True, chunk_steps=self.chunk)
+            pipeline=self._ring_k is None, chunk_steps=self.chunk,
+            **({"ring_k": self._ring_k} if self._ring_k is not None
+               else {}))
 
     # ------------------------------------------------------------------
     # Submission / inspection.
@@ -259,6 +275,8 @@ class ResidentFleet:
         # stale reference after an exception would point at freed
         # buffers.
         self._st = self._admit(self._st)
+        if self._ring_k is not None:
+            return self._serve_ring(max_chunks)
         with self._lg.span(tledger.DISPATCH, run=self._rid,
                            chunk=self._dispatched):
             self._st, dg = self._run(self._st)
@@ -276,6 +294,47 @@ class ResidentFleet:
             self._st = self._boundary(self._st, d)
         d = self._poll_one(dg)                    # the final in-flight chunk
         self._st = self._boundary(self._st, d)
+        return self
+
+    def _serve_ring(self, max_chunks: int):
+        """The device-wrap serve pump: one SEQUENTIAL outer call retires
+        up to ``ring_k`` chunks in-graph (early-exiting when the whole
+        fleet halts), the host reads the ``[ring_k, 13]`` digest ring
+        once, and admission/egress run at the outer-call boundary on the
+        LAST retired chunk's digest.  No double-buffering: the in-graph
+        early exit makes speculative dispatch waste up to ring_k no-op
+        chunks, and the boundary needs the freshest state anyway."""
+        dispatched, oi = 0, 0
+        while dispatched < max_chunks and (self._pending or self._active):
+            cap = min(self._ring_k, max_chunks - dispatched)
+            with self._lg.span(tledger.DISPATCH, run=self._rid,
+                               chunk=self._dispatched, outer=oi, cap=cap):
+                self._st, ring, retired = self._run(self._st, np.int32(cap))
+            with self._lg.span(tledger.POLL, run=self._rid,
+                               chunk=self._dispatched, outer=oi,
+                               cap=cap) as sp:
+                rows, n = sharded._poll_ring(ring, retired)
+                sp.attrs["retired"] = n
+            self._dispatched += n
+            dispatched += n
+            oi += 1
+            base = self.chunks_polled
+            self.chunks_polled += n
+            recs = self._recorder.record_ring(
+                rows, n,
+                steps=[(base + i + 1) * self.chunk for i in range(n)])
+            t = self._now()
+            # first_chunk stamps exactly like _poll_one: a request's rows
+            # have demonstrably run once a chunk at-or-after its
+            # admit_dispatch index has been polled — sequential dispatch
+            # means every admission has executed by this boundary.
+            polled = self.chunks_polled - 1
+            for req in self._active.values():
+                if (req.first_chunk_t is None and req.admitted_t is not None
+                        and polled >= (req.admit_dispatch or 0)):
+                    req.first_chunk_t = t
+                    self._emit_request(req, "first_chunk")
+            self._st = self._boundary(self._st, recs[-1])
         return self
 
     def drain(self, max_chunks: int = MAX_CHUNKS_DEFAULT) -> dict:
@@ -544,6 +603,13 @@ class ResidentFleet:
                 "serve_version": tschema.SERVE_VERSION,
                 "slots": self.slots,
                 "chunk": self.chunk,
+                # Informational (additive, no version bump): the dispatch
+                # wrap is NOT pinned by the checkpoint — chunk state is
+                # wrap-independent, so a service saved under one wrap
+                # resumes bit-identically under either (the restore
+                # params decide; tests/test_checkpoint.py pins the
+                # cross-wrap resume for the underlying fleet).
+                "ring_k": self._ring_k,
                 "chunks_polled": self.chunks_polled,
                 "active": {str(s): req_dict(r)
                            for s, r in self._active.items()},

@@ -395,6 +395,14 @@ class RuntimeLedger:
             rows = [sp.to_json() for sp in self.spans]
         return pipeline_stats(rows, run=run)
 
+    def ring_stats(self, run: int | None = None) -> dict | None:
+        """Ring-dispatch health of one ``wrap="device"`` loop — see the
+        module-level :func:`ring_stats`; ``None`` when the selected run
+        recorded no ring polls (a host-wrap loop)."""
+        with self._lock:
+            rows = [sp.to_json() for sp in self.spans]
+        return ring_stats(rows, run=run)
+
     def summary(self) -> dict:
         comp_s = sum(e["compile_s"] for e in self.compiles)
         return {
@@ -521,6 +529,50 @@ def pipeline_stats(rows, run: int | None = None,
     out["bubbles"] = bubbles
     out["bubble_count"] = len(bubbles)
     return out
+
+
+def ring_stats(rows, run: int | None = None) -> dict | None:
+    """Ring-dispatch health of one ``wrap="device"`` loop (pure row math,
+    the :func:`pipeline_stats` twin for the in-graph chunk loop).
+
+    Consumes the ring POLL spans parallel/sharded.run_sharded records —
+    one per OUTER call, carrying ``retired`` (ring rows actually
+    written) and ``cap`` (the dispatched chunk budget).  Returns ``None``
+    when the selected run has no ring spans (a host-wrap ledger), so
+    viewers can branch on presence.
+
+    * ``retired_per_dispatch`` — mean chunks retired per outer call: the
+      dispatch amortization the device wrap buys (up to ring_k).
+    * ``polls_per_retired_chunk`` — outer calls / retired chunks: the
+      headline, 1.0 on the host wrap, <= 1/ring_k here on non-halting
+      horizons.
+    * ``ring_full`` — outer calls that retired their full budget
+      (``retired == cap``: no early exit).
+    * ``early_exit`` — outer calls that stopped short of ``cap``: the
+      all-halted predicate fired mid-ring.
+    """
+    spans = [r for r in rows if r.get("kind") == "span"
+             and r.get("name") == POLL and "retired" in r]
+    if run is None:
+        runs = [r.get("run") for r in spans if r.get("run") is not None]
+        run = runs[-1] if runs else None
+    if run is not None:
+        spans = [r for r in spans if r.get("run") == run]
+    if not spans:
+        return None
+    retired = sum(int(r["retired"]) for r in spans)
+    full = sum(1 for r in spans
+               if "cap" in r and int(r["retired"]) >= int(r["cap"]))
+    return {
+        "run": run,
+        "dispatches": len(spans),
+        "retired_chunks": retired,
+        "retired_per_dispatch": round(retired / len(spans), 4),
+        "polls_per_retired_chunk": (round(len(spans) / retired, 4)
+                                    if retired else None),
+        "ring_full": full,
+        "early_exit": len(spans) - full,
+    }
 
 
 def _run_seconds(spans) -> float:

@@ -259,13 +259,23 @@ class Observatory:
         ``t0_s``/``t1_s``/``rows`` plus an ``ev_per_s`` rate.  One host's
         view when ``host`` is given; otherwise host p0's stream if
         present (every host's digest is mesh-reduced — summing across
-        hosts would double-count the fleet)."""
+        hosts would double-count the fleet).
+
+        Ring-batched rows (``wrap="device"``: K rows under ONE poll
+        timestamp, stream.TimelineRecorder.record_ring) fold like any
+        other rows: each ring row is its chunk's TRUE cumulative digest,
+        so windowing by the LAST row per window yields the exact sum of
+        the K per-chunk deltas — never one collapsed poll's worth.  Rows
+        sort by (t_s, chunk) so a ring batch keeps retirement order even
+        at equal timestamps, and windows report ``ring_rows`` (how many
+        of their rows came from ring batches) when any did."""
         w = window_s if window_s is not None else self.window_s
         if host is None:
             hosts = self.hosts()
             host = "p0" if "p0" in hosts else (hosts[0] if hosts else None)
         rows = sorted((r for r in self.select(kind="row", host=host)
-                       if "t_s" in r), key=lambda r: r["t_s"])
+                       if "t_s" in r),
+                      key=lambda r: (r["t_s"], r.get("chunk", 0)))
         if not rows:
             return []
         counters = [n for n, _ in schema.DIGEST_SLOTS
@@ -288,6 +298,9 @@ class Observatory:
             last = wrows[-1]
             win = {"t0_s": t0, "t1_s": t1, "rows": len(wrows),
                    "host": host}
+            ring_rows = sum(1 for r in wrows if "ring_i" in r)
+            if ring_rows:
+                win["ring_rows"] = ring_rows
             for n in counters:
                 cur = int(last.get(n, prev[n]))
                 win[n] = cur - prev[n]

@@ -23,7 +23,11 @@ from __future__ import annotations
 
 #: Frozen schema version per serialized stream kind.  Bump an entry when
 #: ANY field/slot of that kind is added, removed, or reordered; every
-#: decoder hard-refuses a mismatch.
+#: decoder hard-refuses a mismatch.  Exception: strictly ADDITIVE
+#: per-row annotations that no decoder dispatches on (RING_ROW_FIELDS
+#: below) land without a bump — a v-N reader decodes the row correctly
+#: by ignoring them, which is the opposite of the silent-misattribution
+#: hazard the version gate exists for.
 VERSIONS = {
     # The fleet digest stream (telemetry/stream.py): the telemetry-plane
     # registration order + the digest/watchdog slot orders below.
@@ -78,6 +82,15 @@ WD_DETECTORS = ("stall", "queue_sat", "sync_jump", "safety_conflict",
 #: with their DIGEST_SLOTS aggregation kind instead).
 COUNTER_SLOTS = frozenset(
     name for name, agg in DIGEST_SLOTS if agg == SUM) - {"halted"}
+
+#: Ring-batch annotations on ``kind="row"`` lines (wrap="device"
+#: dispatch, TimelineRecorder.record_ring): ``ring_i`` is the row's
+#: 0-based position within one outer call's retired batch, ``ring_n``
+#: the batch size — up to ring_n rows share one host poll timestamp
+#: while each keeps its own chunk's true cumulative counters.  Absent on
+#: per-chunk-polled (wrap="host") rows; additive-only, so no
+#: fleet_stream version bump (see VERSIONS).
+RING_ROW_FIELDS = ("ring_i", "ring_n")
 
 
 def require_registry_version(version, what: str = "artifact") -> None:

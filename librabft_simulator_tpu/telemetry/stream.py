@@ -294,6 +294,58 @@ class TimelineRecorder:
         self._emit(row)
         return row
 
+    def record_ring(self, ring, retired, steps=None) -> list[dict]:
+        """Append one outer call's retired digest-ring rows (the
+        ``wrap="device"`` dispatch of parallel/sharded.py): the first
+        ``retired`` rows of an already-fetched ``[ring_k, D]`` ring,
+        oldest first.  There was ONE host egress, so all rows land under
+        one poll timestamp, annotated ``ring_i``/``ring_n``
+        (schema.RING_ROW_FIELDS) so viewers can tell a ring batch from
+        per-chunk polls.  Each row still carries its own chunk's TRUE
+        cumulative counters — ring rows are in-state digests, so
+        consecutive differences are exact per-chunk deltas and the
+        observatory's windowed rollups difference them like any other
+        rows.  ``ev_per_s`` attributes the poll interval evenly across
+        the batch (the host cannot observe sub-poll timing).  ``steps``
+        is an optional sequence of per-row step counts (length >=
+        ``retired``)."""
+        ring = np.asarray(ring)
+        n = int(retired)
+        if not 1 <= n <= ring.shape[0]:
+            raise ValueError(
+                f"retired={n} outside the ring's [1, {ring.shape[0]}] rows")
+        t = time.perf_counter()
+        dt = max(t - self._last_t, 1e-9)
+        elapsed = t - self._t0
+        per = dt / n
+        out = []
+        for i in range(n):
+            d = decode_digest(ring[i])
+            row = {
+                "kind": "row",
+                "chunk": len(self.rows),
+                "t_s": round(elapsed, 6),
+                "steps": None if steps is None else steps[i],
+                "ring_i": i,
+                "ring_n": n,
+                **d,
+                "ev_per_s": round((d["events"] - self._last_events) / per,
+                                  1),
+            }
+            if self.total_instances:
+                row["halt_frac"] = round(
+                    d["halted"] / self.total_instances, 6)
+                row["eta_s"] = (
+                    round(elapsed * (self.total_instances - d["halted"])
+                          / d["halted"], 3)
+                    if d["halted"] > 0 and elapsed > 0 else None)
+            self._last_events = d["events"]
+            self.rows.append(row)
+            self._emit(row)
+            out.append(row)
+        self._last_t = t
+        return out
+
     def summary(self, tail: int = 8) -> dict:
         """The compact block run-reports / bench rows attach: registry
         version, chunk count, final digest, mean throughput, and the last

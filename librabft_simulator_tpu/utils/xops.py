@@ -136,23 +136,79 @@ def macro_mode(override=None) -> int:
     return k
 
 
+#: Environment override for the dispatch wrap (host|device); see
+#: ``SimParams.wrap``.
+WRAP_ENV = "LIBRABFT_WRAP"
+
+_VALID_WRAPS = ("host", "device")
+
+
+def wrap_mode(override=None) -> str:
+    """Resolve the dispatch wrap: explicit ``SimParams.wrap`` >
+    ``WRAP_ENV`` env var > ``"host"`` (the exact pre-ring contract).
+    Strict parse — an unrecognized value raises instead of silently
+    benching the wrong dispatch loop."""
+    if override is not None:
+        wrap = override
+    else:
+        wrap = os.environ.get(WRAP_ENV, "").strip() or "host"
+    if wrap not in _VALID_WRAPS:
+        raise ValueError(f"{WRAP_ENV}={wrap!r}: want one of {_VALID_WRAPS}")
+    return wrap
+
+
+#: Environment override for the device-wrap digest-ring depth (positive
+#: int); see ``SimParams.ring_k``.
+RING_ENV = "LIBRABFT_RING_K"
+
+#: Ring depth when wrap="device" and neither SimParams.ring_k nor
+#: RING_ENV picked one (the BENCH_RING ladder's knee on the CPU proxy).
+DEFAULT_RING_K = 16
+
+
+def ring_mode(override=None, wrap: str = "host"):
+    """Resolve the digest-ring depth: explicit ``SimParams.ring_k`` >
+    ``RING_ENV`` env var > ``DEFAULT_RING_K`` — but ALWAYS ``None`` when
+    the resolved ``wrap`` is ``"host"``, so the host flavor's
+    compile/AOT keys never vary with a stray ``RING_ENV``.  Strict
+    parse, same contract as :func:`macro_mode`."""
+    if wrap == "host":
+        return None
+    if override is not None:
+        return int(override)
+    env = os.environ.get(RING_ENV, "").strip()
+    if not env:
+        return DEFAULT_RING_K
+    try:
+        k = int(env)
+    except ValueError:
+        raise ValueError(f"{RING_ENV}={env!r}: want a positive integer")
+    if k < 1:
+        raise ValueError(f"{RING_ENV}={env!r}: want a positive integer")
+    return k
+
+
 def resolve_params(p):
     """Resolve the 'auto' lowering fields of a SimParams (``dense_writes``,
-    ``packed``, ``gate_handlers``, ``macro_k``) against the active backend
-    and environment.  Engines call this at make-time, BEFORE
-    ``structural()`` memoization, so every cached executable is keyed by
-    the concrete forms it was traced with."""
+    ``packed``, ``gate_handlers``, ``macro_k``, ``wrap``, ``ring_k``)
+    against the active backend and environment.  Engines call this at
+    make-time, BEFORE ``structural()`` memoization, so every cached
+    executable is keyed by the concrete forms it was traced with."""
     import dataclasses
 
     mode = backend_mode(p.dense_writes)
     packed = packed_mode(p.packed)
     gate = gate_mode(p.gate_handlers)
     macro = macro_mode(p.macro_k)
+    wrap = wrap_mode(p.wrap)
+    ring = ring_mode(p.ring_k, wrap=wrap)
     if (mode == p.dense_writes and packed == p.packed
-            and gate == p.gate_handlers and macro == p.macro_k):
+            and gate == p.gate_handlers and macro == p.macro_k
+            and wrap == p.wrap and ring == p.ring_k):
         return p
     return dataclasses.replace(p, dense_writes=mode, packed=packed,
-                               gate_handlers=gate, macro_k=macro)
+                               gate_handlers=gate, macro_k=macro,
+                               wrap=wrap, ring_k=ring)
 
 
 def scatter_set(dst, idx, src, *, mode: str = "scatter"):

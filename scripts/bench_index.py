@@ -56,7 +56,13 @@ FAMILIES = (
     ("KERNEL_CENSUS", lambda d: f"{len(d['modes'])} modes censused"),
     ("GRAPH_AUDIT", lambda d: f"clean={d['clean']}, "
                               f"{d['n_errors']} errors"),
-    ("RUNTIME_LEDGER", lambda d: f"ttfc={d['time_to_first_chunk_s']}s"),
+    # r14's ring-ladder flavor carries per-depth rungs; earlier rounds
+    # are single-run ledgers — both headline on ttfc, the shared field.
+    ("RUNTIME_LEDGER",
+     lambda d: (f"{len(d['rungs'])} ring rungs, "
+                f"ttfc={d['time_to_first_chunk_s']}s"
+                if d.get("flavor") == "ring_dispatch"
+                else f"ttfc={d['time_to_first_chunk_s']}s")),
     ("MULTICHIP_FLEET", lambda d: f"{len(d['rungs'])} rungs, "
                                   f"{len(d['failures'])} failures"),
     ("MULTIHOST_FLEET", lambda d: f"{len(d['rungs'])} rungs, "

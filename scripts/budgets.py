@@ -34,6 +34,16 @@ toolchains.
   — on this toolchain the detectors cost 6 top-level fusion sites).
 * ``census_sharded`` 1160   — per-shard program 1081 (tpu_shape +
   scan/pack/halt-digest overhead) + headroom.
+* ``census_ring_k4`` 1170 / ``census_ring_k16`` 1170 — the device
+  -dispatch ring programs (SimParams.wrap="device"; parallel/sharded.py
+  round 19): per-shard 1091 top fusions at BOTH K=4 and K=16 (round-19
+  container) — the in-graph `lax.while_loop` chunk-retirement body is
+  ONE chunk, so the dispatched program costs +10 fusion sites over the
+  sharded base 1081 (ring dynamic_update_slice + halt predicate + cap
+  compare) and stays ~flat in K while retiring up to K chunks per
+  dispatch; + ~7% headroom like the others.  A ring budget ballooning
+  toward K x census_sharded means XLA started unrolling the retirement
+  loop — the amortization's compile-size guarantee died.
 * ``census_scenario`` 1140 — the per-slot scenario-plane graph
   (SimParams.scenario; serve/scenario.py): tpu_shape_scenario 1068 vs
   1047 off on the round-14 container (the same tree measures off at
@@ -89,6 +99,8 @@ BUDGETS = {
     "census_telemetry": 1090,
     "census_watchdog": 1080,
     "census_sharded": 1160,
+    "census_ring_k4": 1170,
+    "census_ring_k16": 1170,
     "census_k4": 1090,
     "census_k16": 1090,
     "census_scenario": 1140,
@@ -111,7 +123,9 @@ BUDGETS = {
 #: off, donated like every other state leaf; the round-16 pins were
 #: 110/108); the serial/lane runners donate exactly the state argument
 #: (tables and the lane lookahead scalar are host-reused), the sharded
-#: runner's ONLY input is the donated state, install_rows donates the
+#: runner's ONLY input is the donated state (the ring flavor adds the
+#: host's chunk-budget cap scalar, read-only — never donated),
+#: install_rows donates the
 #: resident state but never the admission mask/donor, and the checkify
 #: sanitizer build donates NOTHING (callers hand it externally-held
 #: states with no dedupe obligation).
@@ -122,6 +136,7 @@ DONATION = {
     "serial/scenario": 114,
     "lane/digest": 112,
     "sharded/digest": 114,
+    "sharded/ring": 114,
     "sharded/scenario": 114,
     "serve/install": 114,
     "sanitize/serial": 0,
@@ -133,6 +148,8 @@ SH_VARS = {
     "census_telemetry": "TELEMETRY_CENSUS_BUDGET",
     "census_watchdog": "WATCHDOG_CENSUS_BUDGET",
     "census_sharded": "SHARDED_CENSUS_BUDGET",
+    "census_ring_k4": "RING_K4_CENSUS_BUDGET",
+    "census_ring_k16": "RING_K16_CENSUS_BUDGET",
     "census_k4": "K4_CENSUS_BUDGET",
     "census_k16": "K16_CENSUS_BUDGET",
     "census_scenario": "SCENARIO_CENSUS_BUDGET",

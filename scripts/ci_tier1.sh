@@ -44,8 +44,8 @@ echo "=== static audit v2, fast families (jaxpr R1-R6, source S1-S4, donation D1
 # exactly the chunk executables the HLO pass compiles; cold it would
 # blow this stage's budget).  The artifact is always written.
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
-    --assert-clean --no-hlo --out GRAPH_AUDIT_r17.json; then
-    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r17.json)" >&2
+    --assert-clean --no-hlo --out GRAPH_AUDIT_r19.json; then
+    echo "FAIL: static audit not clean (see GRAPH_AUDIT_r19.json)" >&2
     exit 1
 fi
 
@@ -105,8 +105,8 @@ echo "=== static audit v2, compiled-HLO leg (scatter class + provenance, digest-
 # stage already passed; the HLO artifact lands beside the main one.
 if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/graph_audit.py \
     --assert-clean --engines "" --no-sharded --no-source --no-donation \
-    --no-concurrency --out GRAPH_AUDIT_r17_hlo.json; then
-    echo "FAIL: compiled-HLO audit not clean (see GRAPH_AUDIT_r17_hlo.json)" >&2
+    --no-concurrency --out GRAPH_AUDIT_r19_hlo.json; then
+    echo "FAIL: compiled-HLO audit not clean (see GRAPH_AUDIT_r19_hlo.json)" >&2
     exit 1
 fi
 
@@ -198,12 +198,14 @@ timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest \
     -p no:xdist -p no:randomly
 obs_rc=$?
 
-echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro / ${SCENARIO_CENSUS_BUDGET} scenario / ${ADVERSARY_CENSUS_BUDGET} adversary / ${ADVERSARY_LANE_CENSUS_BUDGET} adversary-lane) ==="
+echo "=== kernel census regression gate (budgets: ${CENSUS_BUDGET} off / ${TELEMETRY_CENSUS_BUDGET} telemetry-on / ${WATCHDOG_CENSUS_BUDGET} watchdog-on / ${SHARDED_CENSUS_BUDGET} per-shard / ${RING_K4_CENSUS_BUDGET} ring-k4 / ${RING_K16_CENSUS_BUDGET} ring-k16 / ${K4_CENSUS_BUDGET} k4 / ${K16_CENSUS_BUDGET} k16 macro / ${SCENARIO_CENSUS_BUDGET} scenario / ${ADVERSARY_CENSUS_BUDGET} adversary / ${ADVERSARY_LANE_CENSUS_BUDGET} adversary-lane) ==="
 JAX_PLATFORMS=cpu python scripts/kernel_census.py \
     --assert-max "${CENSUS_BUDGET}" \
     --assert-telemetry-max "${TELEMETRY_CENSUS_BUDGET}" \
     --assert-watchdog-max "${WATCHDOG_CENSUS_BUDGET}" \
     --assert-sharded-max "${SHARDED_CENSUS_BUDGET}" \
+    --assert-ring-k4-max "${RING_K4_CENSUS_BUDGET}" \
+    --assert-ring-k16-max "${RING_K16_CENSUS_BUDGET}" \
     --assert-k4-max "${K4_CENSUS_BUDGET}" \
     --assert-k16-max "${K16_CENSUS_BUDGET}" \
     --assert-scenario-max "${SCENARIO_CENSUS_BUDGET}" \

@@ -179,6 +179,17 @@ def show_ledger(path: str, out=None) -> int:
               f"bubbles={pipe['bubble_count']} "
               f"time_to_first_chunk={pipe.get('time_to_first_chunk_s')}s",
               file=out)
+        ring = tledger.ring_stats(rows, run=rid)
+        if ring:
+            # Device-dispatch ring loops (SimParams.wrap="device"): the
+            # poll-amortization columns the ring exists for.
+            print(f"# ring: dispatches={ring['dispatches']} "
+                  f"retired_chunks={ring['retired_chunks']} "
+                  f"retired_per_dispatch={ring['retired_per_dispatch']} "
+                  f"polls_per_retired_chunk="
+                  f"{ring['polls_per_retired_chunk']} "
+                  f"ring_full={ring['ring_full']} "
+                  f"early_exit={ring['early_exit']}", file=out)
         print(f"{'chunk':>5} {'dispatch_ms':>12} {'poll_ms':>9}  note",
               file=out)
         for row in pipe["rows"]:

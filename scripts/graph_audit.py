@@ -46,7 +46,7 @@ Usage:
     JAX_PLATFORMS=cpu python scripts/graph_audit.py --assert-clean
     python scripts/graph_audit.py --shape micro --sanitize
     python scripts/graph_audit.py --no-hlo --no-donation   # jaxpr+AST only
-    python scripts/graph_audit.py --out GRAPH_AUDIT_r17.json
+    python scripts/graph_audit.py --out GRAPH_AUDIT_r19.json
 """
 
 from __future__ import annotations

@@ -46,7 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # budgets
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 if "--sharded" in sys.argv or "--assert-budgets" in sys.argv or any(
-        a.startswith("--assert-sharded-max") for a in sys.argv):
+        a.startswith("--assert-sharded-max") or a.startswith("--assert-ring")
+        for a in sys.argv):
     # The sharded census needs virtual devices BEFORE backend init (and
     # --assert-sharded-max implies --sharded, so it must trigger the shim
     # too — argparse runs far too late to force the device count).
@@ -203,6 +204,29 @@ def census_sharded(p: SimParams, batch: int, dp: int) -> dict:
     return hlo_counts(compiled.as_text())
 
 
+def census_ring(p: SimParams, batch: int, dp: int, ring_k: int) -> dict:
+    """Per-shard census of the DEVICE dispatch wrap (SimParams.wrap=
+    "device"; parallel/sharded.py): the ring runner whose outer program
+    retires up to ``ring_k`` chunks in an in-graph while loop, streaming
+    each retired chunk's digest into the on-device ``[ring_k, 13]`` ring.
+    The chunk body is the identical graph to :func:`census_sharded`'s, so
+    the fusion count should be that census plus O(1) while/ring-update
+    overhead — FLAT in ring_k (the ring loop is rolled; a budget climbing
+    with K means the loop body got duplicated).  ``cap`` is lowered as a
+    traced scalar, exactly as the host passes it."""
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+    from librabft_simulator_tpu.parallel import sharded
+
+    p = dataclasses.replace(p, wrap="device", ring_k=ring_k)
+    mesh = mesh_ops.make_mesh(n_dp=dp, n_mp=1, devices=jax.devices()[:dp])
+    st = S.init_batch(p, np.arange(batch, dtype=np.uint32))
+    st, _ = sharded.pad_to_multiple(p, st, mesh.size)
+    st = mesh_ops.shard_batch(mesh, st)
+    run = sharded.make_sharded_run_fn(p, mesh, 1)
+    compiled = run.lower(st, np.int32(ring_k)).compile()
+    return hlo_counts(compiled.as_text())
+
+
 MODES = {
     # The pre-PR serial-step graph, exactly: per-leaf node state,
     # .at[] queue scatters, handlers computed unconditionally.
@@ -317,6 +341,15 @@ def main() -> int:
                     help="exit nonzero if the per-shard tpu_shape fusion "
                          "count exceeds this budget (CI gate; implies "
                          "--sharded)")
+    ap.add_argument("--assert-ring-k4-max", type=int, default=None,
+                    help="exit nonzero if the per-shard DEVICE-wrap ring "
+                         "runner's fusion count at ring_k=4 exceeds this "
+                         "budget (CI gate; implies --sharded)")
+    ap.add_argument("--assert-ring-k16-max", type=int, default=None,
+                    help="exit nonzero if the ring_k=16 ring runner's "
+                         "fusion count exceeds this budget (CI gate; the "
+                         "k4/k16 pair pins the count FLAT in ring_k — the "
+                         "ring loop is rolled; implies --sharded)")
     ap.add_argument("--assert-budgets", action="store_true",
                     help="apply all four census budgets from "
                          "scripts/budgets.py (the CI single source) — "
@@ -348,7 +381,13 @@ def main() -> int:
             args.assert_adversary_max = b["census_adversary"]
         if args.assert_adversary_lane_max is None:
             args.assert_adversary_lane_max = b["census_adversary_lane"]
-    if args.assert_sharded_max is not None:
+        if args.assert_ring_k4_max is None:
+            args.assert_ring_k4_max = b["census_ring_k4"]
+        if args.assert_ring_k16_max is None:
+            args.assert_ring_k16_max = b["census_ring_k16"]
+    if (args.assert_sharded_max is not None
+            or args.assert_ring_k4_max is not None
+            or args.assert_ring_k16_max is not None):
         args.sharded = True
 
     from librabft_simulator_tpu.telemetry import plane as tplane
@@ -412,6 +451,16 @@ def main() -> int:
               f"total_fusions={c['total_fusions']:5d} "
               f"whiles={c['whiles']} scatters={c['scatters']} "
               f"(per shard, dp={args.sharded_dp})", flush=True)
+        for rk in (4, 16):
+            c = census_ring(p_sh, args.batch, args.sharded_dp, rk)
+            out["modes"][f"sharded_ring_k{rk}"] = c
+            print(f"{f'sharded_ring_k{rk}':18s} "
+                  f"top_fusions={c['top_fusions']:4d} "
+                  f"top_dispatch={c['top_dispatch']:4d} "
+                  f"total_fusions={c['total_fusions']:5d} "
+                  f"whiles={c['whiles']} scatters={c['scatters']} "
+                  f"(device wrap, per shard, dp={args.sharded_dp})",
+                  flush=True)
 
     before = out["modes"]["baseline_pre_pr"]["top_fusions"]
     after = out["modes"]["tpu_shape"]["top_fusions"]
@@ -455,6 +504,15 @@ def main() -> int:
             print(f"FAIL: sharded_tpu_shape per-shard fusion count {sh} "
                   f"exceeds budget {args.assert_sharded_max}",
                   file=sys.stderr)
+            return 1
+    for rk, budget in ((4, args.assert_ring_k4_max),
+                       (16, args.assert_ring_k16_max)):
+        if budget is None:
+            continue
+        rc = out["modes"][f"sharded_ring_k{rk}"]["top_fusions"]
+        if rc > budget:
+            print(f"FAIL: sharded_ring_k{rk} per-shard fusion count {rc} "
+                  f"exceeds budget {budget}", file=sys.stderr)
             return 1
     return 0
 

@@ -23,6 +23,11 @@ referees don't time anything.  This sentinel closes that hole:
     (lower; measured once — later reps are warm by construction)
   - ``serve_admit``  — resident-fleet submitted->admitted request
     latency (median over SERVE_SLOTS requests; lower)
+  - ``ring_dispatch`` — 2-shard ``run_sharded`` seconds per RETIRED
+    chunk under the device dispatch wrap (FLEET_RING_SER_KW: the
+    in-graph ring loop at ring_k=FLEET_RING_K; lower) — the
+    fleet_chunk twin whose outer program retires up to K chunks per
+    host round-trip
 
 * **History** — every run appends ONE NDJSON row (schema
   ``bench_history`` v1, telemetry/schema.py) to the committed
@@ -97,6 +102,7 @@ RUNG_META = {
     "macro_k16": ("higher", "events/s"),
     "aot_ttfc": ("lower", "s"),
     "serve_admit": ("lower", "s"),
+    "ring_dispatch": ("lower", "s/chunk"),
 }
 
 PERF_REGRESS = "perf-regress"
@@ -129,8 +135,8 @@ def _collect_samples(rungs, reps: int) -> dict:
     import jax
 
     from fleet_shapes import (FLEET_B, FLEET_CHUNK, FLEET_LANE_KW,
-                              FLEET_SER_KW, SERVE_CHUNK, SERVE_DP,
-                              SERVE_SLOTS)
+                              FLEET_RING_SER_KW, FLEET_SER_KW,
+                              SERVE_CHUNK, SERVE_DP, SERVE_SLOTS)
     from librabft_simulator_tpu.core.types import SimParams
     from librabft_simulator_tpu.parallel import mesh as mesh_ops
     from librabft_simulator_tpu.parallel import sharded
@@ -157,7 +163,7 @@ def _collect_samples(rungs, reps: int) -> dict:
                       **dict(FLEET_SER_KW, macro_k=16))
 
     mesh2 = None
-    if {"fleet_chunk", "aot_ttfc"} & set(rungs):
+    if {"fleet_chunk", "aot_ttfc", "ring_dispatch"} & set(rungs):
         if len(jax.devices()) < 2:
             raise SystemExit("perf_sentinel: fleet_chunk/aot_ttfc need 2 "
                              "devices (XLA_FLAGS host device count)")
@@ -175,6 +181,26 @@ def _collect_samples(rungs, reps: int) -> dict:
         per_chunk = (float(pipe.get("dispatch_s", 0.0))
                      + float(pipe.get("poll_s", 0.0))) / steady
         return per_chunk, float(pipe.get("time_to_first_chunk_s", 0.0))
+
+    # Same horizon as fleet_chunk — max_clock is runtime data, so the
+    # warmed ring executable (warm_cache SHARDED_SHAPES) is reused.
+    p_ring = SimParams(max_clock=120, **FLEET_RING_SER_KW)
+
+    def ring_dispatch_once():
+        """One device-wrap sharded run; returns seconds per RETIRED
+        chunk (host wall over the in-graph ring loop's chunk count)."""
+        st = S.init_batch(p_ring, sharded.fleet_seeds(0, FLEET_B))
+        sharded.run_sharded(p_ring, mesh2, st,
+                            num_steps=FLEET_CHUNK * FLEET_CHUNKS,
+                            chunk=FLEET_CHUNK)
+        pipe = lg.pipeline_stats()
+        ring = lg.ring_stats()
+        if not ring:
+            raise SystemExit("perf_sentinel: ring_dispatch run recorded "
+                             "no ring polls (wrap='device' not armed?)")
+        return ((float(pipe.get("dispatch_s", 0.0))
+                 + float(pipe.get("poll_s", 0.0)))
+                / max(int(ring["retired_chunks"]), 1))
 
     svc = None
     if "serve_admit" in rungs:
@@ -222,6 +248,8 @@ def _collect_samples(rungs, reps: int) -> dict:
                     samples["fleet_chunk"].append(per_chunk)
                 if ttfc_first is None:
                     ttfc_first = ttfc
+            if "ring_dispatch" in rungs:
+                samples["ring_dispatch"].append(ring_dispatch_once())
             if "serve_admit" in rungs:
                 samples["serve_admit"].append(serve_admit_once(rep))
     finally:

@@ -72,8 +72,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 from fleet_shapes import (  # noqa: E402
     FLEET_ADV_LANE_KW, FLEET_ADV_SER_KW, FLEET_ADV_SERVE_KW, FLEET_B,
     FLEET_CHUNK, FLEET_LANE_KW, FLEET_MACRO_SER_KW, FLEET_MACRO_WD_SER_KW,
-    FLEET_SCENARIO_LANE_KW, FLEET_SCENARIO_SER_KW, FLEET_SER_KW,
-    FLEET_WD_LANE_KW, FLEET_WD_SER_KW, SERVE_CHUNK, SERVE_DP, SERVE_SLOTS)
+    FLEET_RING_LANE_KW, FLEET_RING_SER_KW, FLEET_SCENARIO_LANE_KW,
+    FLEET_SCENARIO_SER_KW, FLEET_SER_KW, FLEET_WD_LANE_KW, FLEET_WD_SER_KW,
+    SERVE_CHUNK, SERVE_DP, SERVE_SLOTS)
 
 # Unsharded reference runs of the tier-1 2-shard parity pair, plus the
 # watchdog-armed twins tests/test_stream.py runs (watchdog and its stall
@@ -157,6 +158,13 @@ SHARDED_SHAPES = [
     # entry admits every attack program as a request and referees it with
     # the in-graph watchdog trip counts.
     ("serial", FLEET_ADV_SERVE_KW, SERVE_SLOTS, SERVE_CHUNK, SERVE_DP),
+    # Device-dispatch ring twins (SimParams.wrap="device"): the in-graph
+    # chunk-retirement runner is its OWN executable family (AOT flavor
+    # "ring"; ring depth in the key) — tests/test_multichip.py's ring
+    # bit-identity referees and the perf sentinel's ring_dispatch rung
+    # run exactly these shapes.
+    ("serial", FLEET_RING_SER_KW, FLEET_B, FLEET_CHUNK, 2),
+    ("parallel", FLEET_RING_LANE_KW, FLEET_B, FLEET_CHUNK, 2),
 ]
 
 #: Shared child preamble: pin the CPU backend BEFORE the jax import and

@@ -71,6 +71,17 @@ SERVE_DP = 2
 # flavor too.  The serve referee arms watchdog (the per-request
 # safety/liveness verdicts fleet_watch --serve shows) + scenario on the
 # same base.
+# Device-dispatch ring twins (SimParams.wrap="device";
+# parallel/sharded.py): the micro fleet pair under the in-graph chunk
+# retirement loop.  ``wrap`` and ``ring_k`` are compile keys (the ring
+# depth is the [K, D] buffer shape and the AOT store's "ring" flavor),
+# so the suite's ring tests, warm_cache's sharded ring children, and
+# the perf sentinel's ring_dispatch rung must all use this K.
+FLEET_RING_K = 4
+FLEET_RING_SER_KW = dict(FLEET_SER_KW, wrap="device", ring_k=FLEET_RING_K)
+FLEET_RING_LANE_KW = dict(FLEET_LANE_KW, wrap="device",
+                          ring_k=FLEET_RING_K)
+
 ADV_WINDOWS = 4
 FLEET_ADV_KW = dict(FLEET_LANE_KW, adversary=True, adv_windows=ADV_WINDOWS)
 # One dict, two engine names (so call sites read naturally): the engines

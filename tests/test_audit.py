@@ -303,6 +303,7 @@ class TestBudgetsAndKnobs:
         ns = SL._load_budgets(REPO)
         assert set(ns) == {"census_off", "census_telemetry",
                            "census_watchdog", "census_sharded",
+                           "census_ring_k4", "census_ring_k16",
                            "census_k4", "census_k16", "census_scenario",
                            "census_adversary", "census_adversary_lane",
                            "tier1_min_dots", "bench_sentinel_tol_pct"}
@@ -321,6 +322,14 @@ class TestBudgetsAndKnobs:
         # silently balloon past K=4 — fusions-per-event amortization is
         # the whole point.
         assert ns["census_k16"] <= ns["census_k4"] + 10
+        # Same flatness pin for the device-dispatch ring (round 19): the
+        # in-graph chunk-retirement while_loop body is ONE chunk, so the
+        # ring program is a bounded premium over the sharded base and may
+        # not balloon with ring depth (K x census_sharded would mean XLA
+        # unrolled the retirement loop).
+        assert ns["census_sharded"] <= ns["census_ring_k4"] \
+            <= ns["census_sharded"] + 100
+        assert ns["census_ring_k16"] <= ns["census_ring_k4"] + 10
         # Fusions per EVENT must amortize >= 3x at K=16 even at budget
         # ceiling (the headroom-adjusted form of the round-11 claim).
         assert ns["census_k16"] / 16 <= ns["census_off"] / 3

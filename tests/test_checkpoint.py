@@ -279,6 +279,53 @@ def test_watchdog_leaf_restore(tmp_path):
                                   np.asarray(st.ctx.commit_count))
 
 
+def test_ring_preemption_resume_across_outer_call(tmp_path, monkeypatch):
+    """Device-dispatch preemption: a wrap="device" fleet checkpointed at
+    an outer-call boundary (the ONLY place state egresses — mid-ring the
+    chunks live in-graph) resumes bit-identically to an uninterrupted
+    run, under BOTH wraps.  The ring retires up to K=4 chunks per outer
+    call, so the saved state is 8 chunks in after just 2 dispatches; the
+    resume may change the wrap (device -> host and device -> device) —
+    like macro_k, the dispatch amortization is a deployment knob, never a
+    trajectory fork.  AOT off: load_sharded's callback-placed arrays are
+    the input form deserialized executables abort on."""
+    from fleet_shapes import (FLEET_B, FLEET_CHUNK, FLEET_RING_SER_KW,
+                              FLEET_SER_KW)
+    from librabft_simulator_tpu.parallel import mesh as mesh_ops
+    from librabft_simulator_tpu.parallel import sharded
+
+    monkeypatch.setenv("LIBRABFT_AOT", "0")
+    p_ring = SimParams(max_clock=120, **FLEET_RING_SER_KW)
+    p_host = SimParams(max_clock=120, **FLEET_SER_KW)
+    seeds = sharded.fleet_seeds(0, FLEET_B)
+    mesh2 = mesh_ops.make_mesh(n_dp=2, n_mp=1, devices=jax.devices()[:2])
+
+    ref = sharded.run_sharded(p_ring, mesh2, S.init_batch(p_ring, seeds),
+                              num_steps=FLEET_CHUNK * 200,
+                              chunk=FLEET_CHUNK)
+
+    # Preempt after 2 outer calls (8 chunks at K=4).
+    mid = sharded.run_sharded(p_ring, mesh2, S.init_batch(p_ring, seeds),
+                              num_steps=FLEET_CHUNK * 8, chunk=FLEET_CHUNK)
+    f = str(tmp_path / "ring.npz")
+    C.save(f, mid)
+
+    for p_resume in (p_host, p_ring):
+        st, n_valid = C.load_sharded(f, p_resume, mesh2)
+        assert n_valid == FLEET_B
+        out = sharded.run_sharded(p_resume, mesh2, st,
+                                  num_steps=FLEET_CHUNK * 200,
+                                  chunk=FLEET_CHUNK, pad=False)
+        wrap = p_resume.wrap or "host"
+        for (pt, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(ref)[0],
+                jax.tree_util.tree_flatten_with_path(out)[0]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)[:FLEET_B],
+                err_msg=f"resume wrap={wrap}: "
+                        + "/".join(str(q) for q in pt))
+
+
 def test_topology_change_dp2_to_dp4_and_dp3(tmp_path, monkeypatch):
     """Elastic-resize substrate: a fleet checkpointed mid-run on a dp=2
     mesh restores onto dp=4 AND dp=3 (the pad-and-mask path — 5 % 3 and

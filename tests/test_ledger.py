@@ -241,6 +241,32 @@ def test_pipeline_stats_overlap_bubbles_ttfc():
     assert tledger.pipeline_stats(rows)["run"] == 8
 
 
+def test_ring_stats_oracle_rows():
+    """(d) ring twin: retired/cap attrs on the outer-call POLL spans feed
+    the amortization math — full vs early-exit classification, the
+    polls-per-retired-chunk headline, None on a host-wrap ledger (no
+    ``retired`` attr anywhere), and run selection (last id wins)."""
+    def ring_row(run, chunk, retired, cap, t0):
+        return dict(_span_row("poll", run, chunk, t0, 0.5),
+                    retired=retired, cap=cap)
+    rows = [
+        _span_row("dispatch", 3, 0, 0.0, 0.1),   # no retired attr: ignored
+        ring_row(3, 0, 4, 4, 0.1),               # full budget
+        ring_row(3, 1, 4, 4, 0.7),               # full budget
+        ring_row(3, 2, 2, 4, 1.3),               # early exit: fleet halted
+        ring_row(4, 0, 1, 4, 9.0),               # later run must win run=None
+    ]
+    out = tledger.ring_stats(rows, run=3)
+    assert out["run"] == 3 and out["dispatches"] == 3
+    assert out["retired_chunks"] == 10
+    assert out["retired_per_dispatch"] == pytest.approx(10 / 3, abs=1e-3)
+    assert out["polls_per_retired_chunk"] == pytest.approx(0.3, abs=1e-3)
+    assert out["ring_full"] == 2 and out["early_exit"] == 1
+    assert tledger.ring_stats(rows)["run"] == 4
+    host_rows = [_span_row("poll", 3, 0, 0.0, 0.5)]
+    assert tledger.ring_stats(host_rows) is None
+
+
 def test_run_sharded_records_chunk_spans():
     """(d): the fleet runtime's per-chunk dispatch-enqueue vs poll spans
     land on the process ledger (the warmed 2-shard micro-fleet shape),
@@ -319,6 +345,15 @@ def test_fleet_watch_ledger_view(tmp_path, capsys):
             pass
         with lg.span(tledger.POLL, run=rid, chunk=chunk):
             pass
+    # A device-wrap run: outer-call polls carry retired/cap, and the
+    # view grows the ring amortization line for it.
+    rid2 = lg.new_run("run_sharded", devices=2, pipeline=False, ring_k=4)
+    with lg.span(tledger.DISPATCH, run=rid2, chunk=0):
+        pass
+    with lg.span(tledger.POLL, run=rid2, chunk=0, retired=4, cap=4):
+        pass
+    with lg.span(tledger.POLL, run=rid2, chunk=1, retired=2, cap=4):
+        pass
     with lg.compile_attribution("abc123", engine="serial", shapes="(5,)x3"):
         lg.on_event("/jax/compilation_cache/cache_hits")
     lg.close()
@@ -328,6 +363,9 @@ def test_fleet_watch_ledger_view(tmp_path, capsys):
     assert "overlap=" in out and "time_to_first_chunk=" in out
     assert "cold (compile)" in out
     assert "abc123" in out and "persistent-hit" in out
+    assert "# ring: dispatches=2 retired_chunks=6" in out
+    assert "polls_per_retired_chunk=0.3333" in out
+    assert "ring_full=1 early_exit=1" in out
 
 
 def test_attribution_cli(tmp_path, capsys):

@@ -18,7 +18,12 @@ engines:
 * the fleet flight-recorder concat lands instance-tagged rows in
   instance-major order, each tail row-for-row the oracle's event log;
 * the mp quorum path armed by SimParams.mp_authors is live in the real
-  step (degenerate n_mp=1 identity).
+  step (degenerate n_mp=1 identity);
+* the device-resident dispatch ring (SimParams.wrap="device": an
+  in-graph while_loop retiring up to ring_k chunks per outer call,
+  streaming [K,13] digests) is bit-identical to the host wrap on BOTH
+  engines at the 2-shard mesh, and its ledger spans amortize the halt
+  poll below one per retired chunk.
 
 The 8-shard full-horizon runs stay @slow (multi-minute compile+run on the
 8 *virtual* device mesh; environment-bound, not logic-bound).
@@ -32,7 +37,8 @@ import numpy as np
 import pytest
 
 from fleet_shapes import (
-    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_SER_KW)
+    FLEET_B, FLEET_CHUNK, FLEET_LANE_KW, FLEET_RING_K, FLEET_RING_LANE_KW,
+    FLEET_RING_SER_KW, FLEET_SER_KW)
 from librabft_simulator_tpu.core.types import SimParams
 from librabft_simulator_tpu.parallel import mesh as mesh_ops
 from librabft_simulator_tpu.parallel import sharded
@@ -272,6 +278,59 @@ def test_non_pipelined_fallback_matches(mesh2, serial_pair):
                                  num_steps=CHUNK * 200, chunk=CHUNK,
                                  wrap="jit")
     assert_leaves_equal(ref, st_jit)
+
+
+# Ring-dispatch fleet shapes: identical structural kwargs to the host-wrap
+# fixtures above except wrap="device" + ring_k (both compile keys), so the
+# trajectory itself is pinned bit-identical to the SAME serial_pair /
+# lane_pair references — the ring changes WHO drives the chunk loop, never
+# what it computes.  Shapes come from tests/fleet_shapes.py so the cache
+# warmer pre-compiles exactly these ring executables.
+P_RING_SER = SimParams(max_clock=120, **FLEET_RING_SER_KW)
+P_RING_LANE = SimParams(max_clock=150, **FLEET_RING_LANE_KW)
+
+
+def test_device_wrap_ring_serial_bit_identical(mesh2, serial_pair):
+    """wrap="device" (in-graph while_loop ring, K=4) retires chunks
+    bit-identically to the host wrap on the serial engine, and the ledger
+    shows the poll amortization the ring exists for: one POLL per
+    dispatched outer call covering >= 1 retired chunks, i.e.
+    polls-per-retired-chunk <= 1 (< 1 once any dispatch retires > 1)."""
+    from librabft_simulator_tpu.telemetry import ledger as tledger
+
+    ref, _ = serial_pair
+    st = sharded.run_sharded(P_RING_SER, mesh2,
+                             S.init_batch(P_RING_SER, SEEDS),
+                             num_steps=CHUNK * 200, chunk=CHUNK)
+    assert_leaves_equal(ref, st)
+    ring = tledger.get().ring_stats()
+    assert ring is not None, "device wrap recorded no ring POLL spans"
+    assert ring["dispatches"] >= 1
+    # Strict amortization: this fleet runs many chunks before halting, so
+    # at least one outer call must retire >1 chunk — the host wrap's 1.0
+    # polls-per-retired-chunk is the bound the ring exists to beat.
+    assert ring["retired_chunks"] > ring["dispatches"]
+    assert ring["polls_per_retired_chunk"] < 1.0
+
+
+def test_device_wrap_ring_lane_bit_identical(mesh2, lane_pair):
+    """Same ring referee for the lane-compacted throughput engine: the
+    make_scan_fn contract is engine-agnostic, so the in-graph ring retires
+    the lane engine's chunks bit-identically too."""
+    ref, _ = lane_pair
+    st = sharded.run_sharded(P_RING_LANE, mesh2,
+                             PE.init_batch(P_RING_LANE, SEEDS),
+                             num_steps=CHUNK * 200, chunk=CHUNK, engine=PE)
+    assert_leaves_equal(ref, st)
+
+
+def test_device_wrap_requires_shard_map(mesh2):
+    """wrap="device" composes with the shard_map wrap only — the GSPMD
+    'jit' wrap has no per-shard body to host the ring while_loop."""
+    with pytest.raises(ValueError, match="shard_map"):
+        sharded.run_sharded(P_RING_SER, mesh2,
+                            S.init_batch(P_RING_SER, SEEDS),
+                            num_steps=CHUNK * 4, chunk=CHUNK, wrap="jit")
 
 
 def test_pad_round_trip_and_seeds():

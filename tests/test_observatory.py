@@ -261,6 +261,47 @@ def test_rollup_hand_folded_synthetic(tmp_path):
     assert all(w["rows"] > 0 for w in roll)
 
 
+def test_rollup_ring_batched_rows_hand_folded(tmp_path):
+    """Ring-batched digest rows (wrap="device": K cumulative rows under
+    ONE host poll timestamp, stream.TimelineRecorder.record_ring) must
+    window to the SUM of the K per-chunk deltas — never collapse into one
+    poll's worth — with retirement order kept at equal t_s and the
+    ring_rows marker on windows that saw a batch."""
+    # One host-wrap chunk, then a K=3 ring batch all polled at t=1.4, then
+    # one more host-wrap chunk.  Counters are TRUE cumulatives per chunk.
+    ring_t = 1.4
+    rows = [_digest_row(0, 0.3, 10, commits=2),
+            dict(_digest_row(1, ring_t, 25, commits=4, halted=1),
+                 ring_i=0, ring_n=3),
+            dict(_digest_row(2, ring_t, 45, commits=7, halted=2),
+                 ring_i=1, ring_n=3),
+            dict(_digest_row(3, ring_t, 50, commits=9, halted=3),
+                 ring_i=2, ring_n=3),
+            _digest_row(4, 2.6, 70, commits=11, halted=5)]
+    path = _write_fleet_stream(str(tmp_path / "ring.ndjson"), rows)
+    obs = tobs.from_paths([path], window_s=1.0)
+    roll = obs.rollup()
+    assert [w["t0_s"] for w in roll] == [0.0, 1.0, 2.0]
+    # Window 1 holds the whole ring batch: its events delta is the SUM of
+    # the three per-chunk deltas (15+20+5), not one chunk's 15.
+    assert [w["events"] for w in roll] == [10, 40, 20]
+    assert [w["commits"] for w in roll] == [2, 7, 2]
+    # Hand-fold oracle: deltas re-accumulate to the final cumulative.
+    assert sum(w["events"] for w in roll) == rows[-1]["events"]
+    assert sum(w["commits"] for w in roll) == rows[-1]["commits"]
+    # halted is a gauge: the LAST ring row in retirement order wins (the
+    # (t_s, chunk) sort keeps order at the shared timestamp).
+    assert [w["halted"] for w in roll] == [0, 3, 5]
+    # Ring provenance: only the batch window carries the marker.
+    assert "ring_rows" not in roll[0]
+    assert roll[1]["ring_rows"] == 3
+    assert "ring_rows" not in roll[2]
+    # series() exposes ALL K ring rows, not one per poll timestamp.
+    ser = obs.series("events")
+    assert [v for _, v in ser] == [10, 25, 45, 50, 70]
+    assert sum(1 for t, _ in ser if t == ring_t) == 3
+
+
 def test_rollup_window_env_knob(tmp_path, monkeypatch):
     path = _write_fleet_stream(
         str(tmp_path / "fleet.ndjson"),

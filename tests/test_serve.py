@@ -274,6 +274,41 @@ def test_resident_loop_digest_only_and_one_compile(monkeypatch, tmp_path):
 
 
 @pytest.mark.slow
+def test_resident_ring_results_match_host_wrap(tmp_path):
+    """Ring-depth serve knob: a fleet armed with ``ring_k`` (device wrap,
+    admission/egress only at outer-call boundaries) drains to the same
+    tagged results as the host-wrap reference, and the process ledger
+    records the outer-call ring polls (retired/cap attrs) the admission
+    -latency tradeoff is measured from."""
+    from fleet_shapes import FLEET_RING_K
+    if len(jax.devices()) < SERVE_DP:
+        pytest.skip("needs virtual devices (conftest sets 8)")
+    mesh = mesh_ops.make_mesh(n_dp=SERVE_DP, n_mp=1,
+                              devices=jax.devices()[:SERVE_DP])
+    specs = [SPECS[0], SPECS[2]]
+    ref = ResidentFleet(P_BASE, slots=SERVE_SLOTS, mesh=mesh,
+                        chunk=SERVE_CHUNK)
+    for i, s in enumerate(specs):
+        ref.submit(s, request_id=f"q{i}")
+    ref_res = ref.drain()
+    ref.close()
+    svc = ResidentFleet(P_BASE, slots=SERVE_SLOTS, mesh=mesh,
+                        chunk=SERVE_CHUNK, ring_k=FLEET_RING_K,
+                        out=str(tmp_path / "ring.ndjson"))
+    for i, s in enumerate(specs):
+        svc.submit(s, request_id=f"q{i}")
+    res = svc.drain()
+    svc.close()
+    assert set(res) == set(ref_res)
+    for rid in res:
+        for key in ("events", "clock", "commits", "safe"):
+            assert res[rid][key] == ref_res[rid][key], (rid, key)
+    ring = tledger.get().ring_stats()
+    assert ring is not None and ring["dispatches"] >= 1
+    assert ring["retired_chunks"] >= ring["dispatches"]
+
+
+@pytest.mark.slow
 def test_service_checkpoint_preemption_round_trip(tmp_path):
     """Preemption/eviction: a mid-flight service checkpoints, restores,
     and finishes with the same results as an uninterrupted one."""
